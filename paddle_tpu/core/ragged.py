@@ -1,0 +1,165 @@
+"""Ragged (LoD) tensors and sparse row tensors as JAX pytrees.
+
+TPU-native re-design of the reference's variable-length-sequence and sparse
+machinery:
+
+  * `RaggedTensor` replaces `LoDTensor` (reference: paddle/framework/
+    lod_tensor.h:43-58 — a dense tensor plus per-level offset vectors).  On
+    TPU all shapes must be static, so the flat `values` array has a static
+    (bucketed/padded) leading dimension and the per-level `row_splits`
+    (int32 offset vectors, same encoding as the reference LoD) are carried as
+    device arrays whose *values* are dynamic but whose shapes (the batch
+    size) are static.  Kernels consume it via segment-ids
+    (`segment_ids()`), never via host-side loops.
+  * `SelectedRows` replaces the reference sparse row tensor
+    (paddle/framework/selected_rows.h:19): `rows` ids + dense `values`,
+    with a static logical `height`.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class RaggedTensor:
+    """values: [T, ...] flat over all sequences of the last lod level.
+    row_splits: list (outer→inner) of int32 offset arrays, each [N_i + 1].
+    nvalid: scalar int32, number of valid rows in `values` (rows beyond it
+    are padding introduced by bucketing)."""
+
+    def __init__(self, values, row_splits, nvalid=None):
+        self.values = values
+        self.row_splits = [jnp.asarray(rs, jnp.int32) for rs in row_splits]
+        if nvalid is None:
+            nvalid = (self.row_splits[-1][-1] if self.row_splits
+                      else jnp.int32(values.shape[0]))
+        self.nvalid = jnp.asarray(nvalid, jnp.int32)
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return ((self.values, self.row_splits, self.nvalid),
+                len(self.row_splits))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        values, row_splits, nvalid = children
+        obj = object.__new__(cls)
+        obj.values = values
+        obj.row_splits = list(row_splits)
+        obj.nvalid = nvalid
+        return obj
+
+    # -- structure ----------------------------------------------------------
+    @property
+    def lod_level(self):
+        return len(self.row_splits)
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def nseq(self, level=0):
+        """Static number of sequences at `level`."""
+        return self.row_splits[level].shape[0] - 1
+
+    def last_splits(self):
+        return self.row_splits[-1]
+
+    def lod(self):
+        """Host copy in the reference's LoD format (list of offset lists)."""
+        return [np.asarray(rs).tolist() for rs in self.row_splits]
+
+    # -- kernels' bridge ----------------------------------------------------
+    def segment_ids(self, level=-1):
+        """int32 [T]: which sequence (at `level`) each row of values belongs
+        to; padding rows get `nseq` (one-past-last segment) so that
+        segment reductions with num_segments=nseq drop them."""
+        rs = self.row_splits[level]
+        nseq = rs.shape[0] - 1
+        pos = jnp.arange(self.values.shape[0], dtype=jnp.int32)
+        seg = jnp.searchsorted(rs, pos, side="right").astype(jnp.int32) - 1
+        valid = pos < self.nvalid
+        return jnp.where(valid, jnp.clip(seg, 0, nseq - 1), nseq)
+
+    def valid_mask(self):
+        pos = jnp.arange(self.values.shape[0], dtype=jnp.int32)
+        return pos < self.nvalid
+
+    def seq_lengths(self, level=-1):
+        rs = self.row_splits[level]
+        return rs[1:] - rs[:-1]
+
+    def with_values(self, values):
+        return RaggedTensor(values, self.row_splits, self.nvalid)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_sequences(seqs, dtype=None, bucket=None):
+        """Build from a python list of per-sequence numpy arrays/lists
+        (lod_level=1).  `bucket` pads the flat T dimension up to a multiple
+        to bound the number of distinct compiled shapes."""
+        arrs = [np.asarray(s, dtype=dtype) for s in seqs]
+        lengths = [a.shape[0] for a in arrs]
+        splits = np.zeros(len(arrs) + 1, np.int32)
+        np.cumsum(lengths, out=splits[1:])
+        total = int(splits[-1])
+        flat = (np.concatenate(arrs, axis=0) if total > 0 else
+                np.zeros((0,) + tuple(arrs[0].shape[1:]), arrs[0].dtype))
+        if bucket:
+            padded_t = max(bucket, int(np.ceil(max(total, 1) / bucket)) * bucket)
+            pad = padded_t - total
+            if pad:
+                flat = np.concatenate(
+                    [flat, np.zeros((pad,) + flat.shape[1:], flat.dtype)], 0)
+        return RaggedTensor(jnp.asarray(flat), [splits], nvalid=total)
+
+    def __repr__(self):
+        return "RaggedTensor(values=%s%s, lod_level=%d, nseq=%d)" % (
+            self.values.shape, self.values.dtype, self.lod_level,
+            self.nseq(0) if self.row_splits else 0)
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """Sparse row-set tensor: `rows` (int32 ids, may repeat), `values`
+    ([nrows, ...] dense), logical `height` (static python int).
+    reference: paddle/framework/selected_rows.h:19."""
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return ((self.rows, self.values), self.height)
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        obj = object.__new__(cls)
+        obj.rows = rows
+        obj.values = values
+        obj.height = height
+        return obj
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def __repr__(self):
+        return "SelectedRows(nrows=%s, height=%d, value=%s)" % (
+            self.rows.shape[0], self.height, self.values.shape)

@@ -1,0 +1,76 @@
+"""Hierarchical name -> value store.
+
+TPU-native equivalent of the reference Scope/Variable runtime store
+(reference: paddle/framework/scope.h:38 `Var`/`FindVar`/`NewScope`,
+paddle/framework/variable.h:25).  Values held here are jax.Arrays (device
+buffers), RaggedTensor / SelectedRows pytrees, or arbitrary host objects
+(rank tables, tensor arrays, reader state).
+"""
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self._parent = parent
+        self._kids = []
+
+    def var(self, name):
+        """Find or create (reference: scope.h Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name):
+        """Returns the scope holding `name`, searching ancestors; None if
+        absent (reference: scope.h Scope::FindVar)."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s
+            s = s._parent
+        return None
+
+    def has_var(self, name):
+        return self.find_var(name) is not None
+
+    def get(self, name, default=None):
+        s = self.find_var(name)
+        return s._vars[name] if s is not None else default
+
+    def set(self, name, value):
+        """Set in the nearest scope already holding `name`, else locally."""
+        s = self.find_var(name)
+        (s if s is not None else self)._vars[name] = value
+
+    def set_local(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def __contains__(self, name):
+        return self.has_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

@@ -1,0 +1,22 @@
+"""Datasets with the reference's reader API.
+
+reference: python/paddle/v2/dataset/ (mnist, cifar, imdb, uci_housing,
+imikolov, movielens, conll05, sentiment, wmt14/16...).
+
+This build runs in an offline environment (zero egress), so each dataset
+is a *deterministic synthetic stand-in* with the exact shapes, dtypes and
+reader API of the original — enough for training-loop, convergence-trend
+and benchmark tests.  Swap in the real loaders by dropping files into
+`~/.cache/paddle_tpu/dataset/` (same layout the reference downloads)."""
+
+from . import uci_housing  # noqa: F401
+from . import mnist        # noqa: F401
+from . import cifar        # noqa: F401
+from . import imdb         # noqa: F401
+from . import imikolov     # noqa: F401
+from . import movielens    # noqa: F401
+from . import conll05      # noqa: F401
+from . import wmt14        # noqa: F401
+
+__all__ = ["uci_housing", "mnist", "cifar", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14"]

@@ -1,0 +1,35 @@
+"""CIFAR-10/100 stand-in (reference: python/paddle/v2/dataset/cifar.py —
+3072-float images, int label)."""
+
+from .common import synthetic_images
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+_TRAIN_N = 1024
+_TEST_N = 256
+
+
+def _reader(n, classes, seed):
+    imgs, labels = synthetic_images(n, (3072,), classes, seed)
+
+    def reader():
+        for i in range(imgs.shape[0]):
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def train10():
+    return _reader(_TRAIN_N, 10, 100)
+
+
+def test10():
+    return _reader(_TEST_N, 10, 101)
+
+
+def train100():
+    return _reader(_TRAIN_N, 100, 102)
+
+
+def test100():
+    return _reader(_TEST_N, 100, 103)

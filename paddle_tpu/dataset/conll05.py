@@ -1,0 +1,49 @@
+"""CoNLL-2005 SRL stand-in (reference: python/paddle/v2/dataset/conll05.py
+— 8 feature sequences + BIO label sequence)."""
+
+from .common import rng
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+_WORDS = 4000
+_PREDS = 300
+_LABELS = 59  # BIO over roles
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_WORDS)}
+    verb_dict = {("v%d" % i): i for i in range(_PREDS)}
+    label_dict = {("l%d" % i): i for i in range(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    import numpy as np
+
+    return rng(33).uniform(-1, 1, size=(_WORDS, 32)).astype("float32")
+
+
+def _reader(n, seed):
+    r = rng(seed)
+
+    def reader():
+        for _ in range(n):
+            length = int(r.randint(5, 35))
+            word = r.randint(0, _WORDS, size=length).tolist()
+            pred_idx = int(r.randint(0, length))
+            predicate = [int(r.randint(0, _PREDS))] * length
+            ctx_n2 = word[max(0, pred_idx - 2):][:1] * length
+            ctx_n1 = word[max(0, pred_idx - 1):][:1] * length
+            ctx_0 = [word[pred_idx]] * length
+            ctx_p1 = word[min(length - 1, pred_idx + 1):][:1] * length
+            ctx_p2 = word[min(length - 1, pred_idx + 2):][:1] * length
+            mark = [1 if i == pred_idx else 0 for i in range(length)]
+            label = r.randint(0, _LABELS, size=length).tolist()
+            yield (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate,
+                   mark, label)
+
+    return reader
+
+
+def test():
+    return _reader(256, 44)

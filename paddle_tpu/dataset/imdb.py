@@ -1,0 +1,32 @@
+"""IMDB sentiment stand-in (reference: python/paddle/v2/dataset/imdb.py —
+word-id sequences + binary label)."""
+
+from .common import synthetic_sequences
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5147
+_TRAIN_N = 512
+_TEST_N = 128
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    data = synthetic_sequences(n, _VOCAB, 2, seed, min_len=8, max_len=60)
+
+    def reader():
+        for seq, label in data:
+            yield seq, label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(_TRAIN_N, 7)
+
+
+def test(word_idx=None):
+    return _reader(_TEST_N, 8)

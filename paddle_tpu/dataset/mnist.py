@@ -1,0 +1,27 @@
+"""MNIST stand-in (reference: python/paddle/v2/dataset/mnist.py —
+784-float images in [-1,1], int label 0-9)."""
+
+from .common import synthetic_images
+
+__all__ = ["train", "test"]
+
+_TRAIN_N = 2048
+_TEST_N = 512
+
+
+def _reader(n, seed):
+    imgs, labels = synthetic_images(n, (784,), 10, seed)
+
+    def reader():
+        for i in range(imgs.shape[0]):
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def train():
+    return _reader(_TRAIN_N, 42)
+
+
+def test():
+    return _reader(_TEST_N, 43)

@@ -1,0 +1,116 @@
+"""DataFeeder: python data -> device tensors / RaggedTensors.
+
+reference: python/paddle/v2/fluid/data_feeder.py:69 (converts reader rows
+into LoDTensors).  Ragged (lod_level>0) slots become RaggedTensor with
+bucketed flat length so the number of compiled shapes stays bounded.
+"""
+
+import numpy as np
+
+from .framework import Variable, default_main_program
+from ..core.ragged import RaggedTensor
+from ..core.types import np_dtype
+
+__all__ = ["DataFeeder"]
+
+# flat token-length bucket for ragged feeds; power-of-two multiples bound
+# the number of distinct XLA compilations
+DEFAULT_RAGGED_BUCKET = 64
+
+
+class DataToRaggedConverter:
+    def __init__(self, place, lod_level, shape, dtype, bucket):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [s for s in shape if s >= 0]
+        self.dtype = dtype
+        self.data = []
+        self.lod = [[0] for _ in range(lod_level)]
+        self.bucket = bucket
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(lod[0][-1] + len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        import jax
+
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            if self.shape is not None:
+                arr = arr.reshape([-1] + list(self.shape))
+            return jax.device_put(arr, self.place.device())
+        flat = [np.asarray(d, dtype=self.dtype) for d in self.data]
+        flat = [f.reshape(self.shape) if self.shape and
+                f.shape != tuple(self.shape) else f for f in flat]
+        values = np.stack(flat, 0) if flat else \
+            np.zeros((0,) + tuple(self.shape), self.dtype)
+        total = values.shape[0]
+        if self.bucket:
+            padded = max(self.bucket,
+                         int(np.ceil(max(total, 1) / self.bucket))
+                         * self.bucket)
+            if padded > total:
+                pad = np.zeros((padded - total,) + values.shape[1:],
+                               values.dtype)
+                values = np.concatenate([values, pad], 0)
+        import jax
+
+        return RaggedTensor(
+            jax.device_put(values, self.place.device()),
+            [np.asarray(l, np.int32) for l in self.lod], nvalid=total)
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place, program=None,
+                 ragged_bucket=DEFAULT_RAGGED_BUCKET):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        self.ragged_bucket = ragged_bucket
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should contain Variables")
+            self.feed_dtypes.append(np_dtype(each_var.dtype))
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = []
+        for lod_level, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes):
+            if lod_level == 0:
+                # drop the leading dim only when it is the dynamic batch
+                # dim; append_batch_size=False vars keep their full shape
+                # (reference: data_feeder.py drops negative dims)
+                sample_shape = list(shape[1:]) if (shape and shape[0] < 0) \
+                    else [s for s in shape if s >= 0] or None
+            else:
+                sample_shape = [s for s in shape if s >= 0]
+            converters.append(DataToRaggedConverter(
+                place=self.place, lod_level=lod_level,
+                shape=sample_shape, dtype=dtype,
+                bucket=self.ragged_bucket))
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "size of each sample must equal feed_list")
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        ret_dict = {}
+        for each_name, each_converter in zip(self.feed_names, converters):
+            ret_dict[each_name] = each_converter.done()
+        return ret_dict

@@ -1,0 +1,146 @@
+"""Stateful metrics as in-graph state + ops.
+
+reference: python/paddle/v2/fluid/evaluator.py (Evaluator base, Accuracy,
+ChunkEvaluator) — accumulator state lives in persistable vars updated by
+ops appended to the main program; eval() builds a small program computing
+the aggregate.
+"""
+
+import numpy as np
+
+from . import framework
+from .framework import unique_name, Program, Variable
+from .layer_helper import LayerHelper
+from .initializer import Constant
+from . import layers
+
+__all__ = ["Accuracy", "ChunkEvaluator", "Evaluator"]
+
+
+def _clone_var_(block, var):
+    return block.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                            lod_level=var.lod_level, persistable=True)
+
+
+class Evaluator:
+    """reference: evaluator.py Evaluator."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        with framework.program_guard(main_program=reset_program):
+            for var in self.states:
+                assert isinstance(var, Variable)
+                g_var = _clone_var_(reset_program.current_block(), var)
+                layers.fill_constant(shape=g_var.shape, value=0.0,
+                                     dtype=g_var.dtype, out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def create_state(self, suffix, dtype, shape):
+        state = self.helper.create_variable(
+            name="_".join([unique_name(self.helper.name), suffix]),
+            persistable=True, dtype=dtype, shape=shape)
+        self.helper.set_variable_initializer(state, Constant(0.0))
+        return state
+
+
+class Accuracy(Evaluator):
+    """Streaming accuracy (reference: evaluator.py Accuracy)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy", **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.total = self.create_state(dtype="int32", shape=[1],
+                                       suffix="total")
+        self.correct = self.create_state(dtype="int32", shape=[1],
+                                         suffix="correct")
+        total = self.helper.create_tmp_variable(dtype="int32",
+                                                stop_gradient=True)
+        correct = self.helper.create_tmp_variable(dtype="int32",
+                                                  stop_gradient=True)
+        acc = layers.accuracy(input=input, label=label, k=k,
+                              correct=correct, total=total)
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.total, total]},
+            outputs={"Out": [self.total]})
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.correct, correct]},
+            outputs={"Out": [self.correct]})
+        self.metrics.append(acc)
+        self.states.extend([self.total, self.correct])
+
+    def eval(self, executor, eval_program=None):
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.current_block()
+        with framework.program_guard(main_program=eval_program):
+            total = _clone_var_(block, self.total)
+            correct = _clone_var_(block, self.correct)
+            total = layers.cast(total, dtype="float32")
+            correct = layers.cast(correct, dtype="float32")
+            out = layers.elementwise_div(x=correct, y=total)
+        return np.array(executor.run(eval_program, fetch_list=[out])[0])
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (reference: evaluator.py ChunkEvaluator)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, **kwargs):
+        super().__init__("chunk_eval", **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.num_infer_chunks = self.create_state(
+            dtype="int32", shape=[1], suffix="num_infer_chunks")
+        self.num_label_chunks = self.create_state(
+            dtype="int32", shape=[1], suffix="num_label_chunks")
+        self.num_correct_chunks = self.create_state(
+            dtype="int32", shape=[1], suffix="num_correct_chunks")
+        precision, recall, f1_score, num_infer_chunks, num_label_chunks, \
+            num_correct_chunks = layers.chunk_eval(
+                input=input, label=label, chunk_scheme=chunk_scheme,
+                num_chunk_types=num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types)
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.num_infer_chunks, num_infer_chunks]},
+            outputs={"Out": [self.num_infer_chunks]})
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.num_label_chunks, num_label_chunks]},
+            outputs={"Out": [self.num_label_chunks]})
+        self.helper.append_op(
+            type="sum",
+            inputs={"X": [self.num_correct_chunks, num_correct_chunks]},
+            outputs={"Out": [self.num_correct_chunks]})
+        self.metrics.extend([precision, recall, f1_score])
+        self.states.extend([self.num_infer_chunks, self.num_label_chunks,
+                            self.num_correct_chunks])
+
+    def eval(self, executor, eval_program=None):
+        from ..core.scope import global_scope
+
+        num_infer = np.asarray(
+            global_scope().get(self.num_infer_chunks.name)).sum()
+        num_label = np.asarray(
+            global_scope().get(self.num_label_chunks.name)).sum()
+        num_correct = np.asarray(
+            global_scope().get(self.num_correct_chunks.name)).sum()
+        precision = float(num_correct) / num_infer if num_infer else 0.0
+        recall = float(num_correct) / num_label if num_label else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if num_correct else 0.0
+        return np.array([precision]), np.array([recall]), np.array([f1])

@@ -1,0 +1,168 @@
+"""LayerHelper: shared parameter-creation / op-append plumbing for layers.
+
+reference: python/paddle/v2/fluid/layer_helper.py:24.
+"""
+
+import itertools
+
+from . import framework
+from .framework import Variable, unique_name, default_main_program, \
+    default_startup_program
+from .initializer import Constant, Xavier
+from .param_attr import ParamAttr
+from ..core.types import is_float_dtype
+
+__all__ = ["LayerHelper"]
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = self.kwargs.get("name")
+        if name is None:
+            self.kwargs["name"] = unique_name(self.layer_type)
+
+    @property
+    def name(self):
+        return self.kwargs["name"]
+
+    @property
+    def main_program(self):
+        return self.kwargs.get("main_program") or default_main_program()
+
+    @property
+    def startup_program(self):
+        return self.kwargs.get("startup_program") or \
+            default_startup_program()
+
+    def append_op(self, *args, **kwargs):
+        return self.main_program.current_block().append_op(*args, **kwargs)
+
+    def multiple_input(self, input_param_name="input"):
+        inputs = self.kwargs.get(input_param_name, [])
+        if isinstance(inputs, Variable):
+            return [inputs]
+        return list(inputs)
+
+    def input(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        if len(inputs) != 1:
+            raise ValueError("%s layer needs exactly one input"
+                             % self.layer_type)
+        return inputs[0]
+
+    @property
+    def param_attr(self):
+        return ParamAttr.to_attr(self.kwargs.get("param_attr"))
+
+    @property
+    def bias_attr(self):
+        return ParamAttr.to_attr(self.kwargs.get("bias_attr"))
+
+    def multiple_param_attr(self, length):
+        attr = self.param_attr
+        if isinstance(attr, ParamAttr):
+            attr = [attr]
+        if len(attr) != 1 and len(attr) != length:
+            raise ValueError("parameter number mismatch")
+        if len(attr) == 1 and length != 1:
+            attr = [attr[0]] + [
+                ParamAttr(**attr[0].to_kwargs()) for _ in range(length - 1)]
+        return attr
+
+    def iter_inputs_and_params(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        attrs = self.multiple_param_attr(len(inputs))
+        return zip(inputs, attrs)
+
+    @property
+    def input_dtype(self):
+        dtype = None
+        for v in self.multiple_input():
+            if dtype is None:
+                dtype = v.dtype
+            elif dtype != v.dtype:
+                raise ValueError("mixed input dtypes")
+        return dtype
+
+    def create_parameter(self, attr, shape, dtype, is_bias=False,
+                         default_initializer=None):
+        assert isinstance(attr, ParamAttr)
+        if attr.name is None:
+            attr.name = unique_name(".".join([self.name, "w"]))
+        if default_initializer is None:
+            if is_bias:
+                attr.set_default_bias_initializer()
+            else:
+                attr.set_default_param_initializer()
+        else:
+            attr.set_default_initializer(default_initializer)
+
+        block = self.main_program.global_block()
+        kwargs = attr.to_kwargs()
+        kwargs.pop("name", None)
+        param = block.create_parameter(
+            shape=[int(s) for s in shape], dtype=dtype,
+            name=attr.name, **kwargs)
+        # mirror into the startup program with its init op
+        startup_block = self.startup_program.global_block()
+        svar = startup_block.create_var(
+            name=attr.name, shape=[int(s) for s in shape], dtype=dtype,
+            persistable=True)
+        attr.initializer(svar, startup_block)
+        return param
+
+    def set_variable_initializer(self, var, initializer):
+        """Create `var` in the startup program and init it there."""
+        startup_block = self.startup_program.global_block()
+        svar = startup_block.create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype,
+            persistable=True)
+        initializer(svar, startup_block)
+        return var
+
+    def create_tmp_variable(self, dtype, stop_gradient=False, lod_level=None):
+        return self.main_program.current_block().create_var(
+            name=unique_name(".".join([self.name, "tmp"])), dtype=dtype,
+            stop_gradient=stop_gradient, lod_level=lod_level)
+
+    def create_variable(self, *args, **kwargs):
+        return self.main_program.current_block().create_var(*args, **kwargs)
+
+    def create_global_variable(self, persistable=False, *args, **kwargs):
+        return self.main_program.global_block().create_var(
+            *args, persistable=persistable, **kwargs)
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        """Add a bias over dims [dim_start, dim_end) of input
+        (reference: layer_helper.py append_bias_op)."""
+        bias_attr = self.bias_attr
+        if bias_attr is None:
+            return input_var
+        size = list(input_var.shape[dim_start:dim_end])
+        b = self.create_parameter(bias_attr, shape=size,
+                                  dtype=input_var.dtype, is_bias=True)
+        tmp = self.create_tmp_variable(dtype=input_var.dtype,
+                                       lod_level=input_var.lod_level)
+        self.append_op(
+            type="elementwise_add",
+            inputs={"X": [input_var], "Y": [b]},
+            outputs={"Out": [tmp]},
+            attrs={"axis": dim_start})
+        return tmp
+
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, str):
+            act = {"type": act}
+        act = dict(act)
+        act_type = act.pop("type")
+        tmp = self.create_tmp_variable(dtype=input_var.dtype,
+                                       lod_level=input_var.lod_level)
+        self.append_op(
+            type=act_type, inputs={"X": [input_var]},
+            outputs={"Out": [tmp]}, attrs=act)
+        return tmp

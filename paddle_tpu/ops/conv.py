@@ -1,0 +1,292 @@
+"""Convolution / pooling / vision op kernels.
+
+TPU-native equivalents of reference ops (paddle/operators/conv_op.cc,
+conv_cudnn_op.cu.cc, conv_transpose_op.cc, pool_op.cc,
+pool_with_index_op.cc, lrn_op.cc, maxout_op.cc, spp_op.cc, unpool_op.cc,
+roi_pool_op.cc, im2sequence_op.cc).  All lower to
+lax.conv_general_dilated / lax.reduce_window, which XLA tiles onto the
+MXU / VPU — the reference's im2col+gemm and cuDNN paths have no analog
+here by design.  Data layout is NCHW at the API (reference parity); XLA
+re-lays out internally for the TPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+@register_op("conv2d")
+def conv2d(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": [out]}
+
+
+@register_op("conv3d")
+def conv3d(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Filter"][0]  # [in_c, out_c, kh, kw] (reference layout)
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    out = lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1),
+        strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": [out]}
+
+
+def _pool2d_impl(x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", [1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = [1, 1]
+        paddings = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides4, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides4, pads)
+        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides4,
+                                       pads)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return out
+
+
+@register_op("pool2d")
+def pool2d(ctx, ins, attrs):
+    return {"Out": [_pool2d_impl(ins["X"][0], attrs)]}
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", [1, 1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = [1, 1, 1]
+        paddings = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides5,
+                                pads)
+    else:
+        out = lax.reduce_window(x, 0.0, lax.add, window, strides5, pads) \
+            / np.prod(ksize)
+    return {"Out": [out]}
+
+
+@register_op("max_pool2d_with_index", nondiff_inputs=())
+def max_pool2d_with_index(ctx, ins, attrs):
+    """reference: pool_with_index_op.cc — also returns flat argmax index
+    per window (for unpool)."""
+    x = ins["X"][0]
+    out = _pool2d_impl(x, dict(attrs, pooling_type="max"))
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", [1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [h, w]
+        strides = [1, 1]
+        paddings = [0, 0]
+    # select index of max via reduce_window over (value, index) pairs
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]))
+    _, idx = lax.reduce_window((x, flat_idx), (-jnp.inf, 0.0), reducer,
+                               window, strides4, pads)
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register_op("unpool", nondiff_inputs=("Indices",))
+def unpool(ctx, ins, attrs):
+    """reference: unpool_op.cc — scatter pooled values back to argmax
+    positions."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0]
+    n, c, h, w = x.shape
+    unpool_size = attrs.get("unpooling_size") or attrs.get("ksize", [2, 2])
+    oh = h * unpool_size[0]
+    ow = w * unpool_size[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx_flat = idx.reshape(n, c, -1)
+    x_flat = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(
+        lambda f, i, v: f.at[i].add(v)))(flat, idx_flat, x_flat)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("lrn")
+def lrn(ctx, ins, attrs):
+    """Local response normalization across channels
+    (reference: lrn_op.cc)."""
+    x = ins["X"][0]
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window_sum = sum(padded[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * window_sum
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("maxout")
+def maxout(ctx, ins, attrs):
+    """reference: maxout_op.cc — max over channel groups."""
+    x = ins["X"][0]
+    groups = int(attrs["groups"])
+    n, c, h, w = x.shape
+    out = jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)
+    return {"Out": [out]}
+
+
+@register_op("spp")
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference: spp_op.cc)."""
+    x = ins["X"][0]
+    levels = int(attrs.get("pyramid_height", 3))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh = int(np.ceil(h / bins))
+        kw = int(np.ceil(w / bins))
+        ph = int((kh * bins - h + 1) / 2)
+        pw = int((kw * bins - w + 1) / 2)
+        pooled = _pool2d_impl(x, {
+            "pooling_type": ptype, "ksize": [kh, kw],
+            "strides": [kh, kw], "paddings": [ph, pw]})
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("roi_pool", nondiff_inputs=("ROIs",))
+def roi_pool(ctx, ins, attrs):
+    """reference: roi_pool_op.cc — max pool over regions of interest."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    if isinstance(rois, RaggedTensor):
+        rois = rois.values
+    pooled_h = int(attrs["pooled_height"])
+    pooled_w = int(attrs["pooled_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def pool_one(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[batch_id]  # [c, h, w]
+        hh = jnp.arange(h)
+        ww = jnp.arange(w)
+
+        def bin_val(ph, pw):
+            hstart = y1 + (ph * roi_h) // pooled_h
+            hend = y1 + ((ph + 1) * roi_h + pooled_h - 1) // pooled_h
+            wstart = x1 + (pw * roi_w) // pooled_w
+            wend = x1 + ((pw + 1) * roi_w + pooled_w - 1) // pooled_w
+            mask = ((hh[:, None] >= hstart) & (hh[:, None] < hend) &
+                    (ww[None, :] >= wstart) & (ww[None, :] < wend))
+            vals = jnp.where(mask[None], img, -jnp.inf)
+            m = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)
+
+        grid = jnp.stack([
+            jnp.stack([bin_val(ph, pw) for pw in range(pooled_w)], -1)
+            for ph in range(pooled_h)], -2)
+        return grid  # [c, pooled_h, pooled_w]
+
+    out = jax.vmap(pool_one)(rois.astype(x.dtype))
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("im2sequence", nondiff_inputs=())
+def im2sequence(ctx, ins, attrs):
+    """reference: im2sequence_op.cc — image patches to a ragged sequence
+    (one sequence per image, one step per patch position)."""
+    x = ins["X"][0]
+    kernels = attrs.get("kernels", [1, 1])
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                     (paddings[1], paddings[3])))
+    kh, kw = kernels
+    sh, sw = strides
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=[(paddings[0], paddings[2]), (paddings[1], paddings[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [n, c*kh*kw, oh, ow] -> [n*oh*ow, c*kh*kw]
+    seq = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    splits = jnp.arange(n + 1, dtype=jnp.int32) * (oh * ow)
+    return {"Out": [RaggedTensor(seq, [splits])]}

@@ -1,0 +1,135 @@
+"""Normalization op kernels: batch_norm, layer_norm, norm (l2).
+
+TPU-native equivalents of reference ops (paddle/operators/
+batch_norm_op.cc + cudnn variant, norm_op.cc; layer_norm is provided for
+completeness though the snapshot predates it).  batch_norm has an explicit
+grad kernel because its forward mutates running stats (in-place outputs)
+which must not be differentiated through.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_kernel
+
+
+@register_op("batch_norm", nondiff_inputs=("Mean", "Variance"))
+def batch_norm(ctx, ins, attrs):
+    """reference: batch_norm_op.cc — training mode uses batch statistics
+    and updates running stats with `momentum`; test mode uses running
+    stats."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    mean = ins["Mean"][0]
+    variance = ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+
+    if layout == "NCHW":
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        bshape = (1,) * (x.ndim - 1) + (-1,)
+
+    if is_test:
+        use_mean, use_var = mean, variance
+        mean_out, var_out = mean, variance
+        saved_mean = mean
+        saved_var = variance
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean + (1 - momentum) * use_mean
+        var_out = momentum * variance + (1 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+
+    inv_std = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv_std.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register_grad_kernel("batch_norm")
+def batch_norm_grad(ctx, ins, attrs):
+    """Explicit vjp of the normalization (running-stat updates carry no
+    gradient; reference: batch_norm_op.cc BatchNormGradKernel)."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    dy = ins["OG@Y"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+    mean = ins["Mean"][0]
+    variance = ins["Variance"][0]
+
+    def f(x_, scale_, bias_):
+        if layout == "NCHW":
+            axes = tuple(i for i in range(x_.ndim) if i != 1)
+            bshape = (1, -1) + (1,) * (x_.ndim - 2)
+        else:
+            axes = tuple(range(x_.ndim - 1))
+            bshape = (1,) * (x_.ndim - 1) + (-1,)
+        if is_test:
+            m, v = mean, variance
+        else:
+            m = jnp.mean(x_, axis=axes)
+            v = jnp.var(x_, axis=axes)
+        inv_std = jax.lax.rsqrt(v + eps)
+        return (x_ - m.reshape(bshape)) * inv_std.reshape(bshape) * \
+            scale_.reshape(bshape) + bias_.reshape(bshape)
+
+    _, vjp = jax.vjp(f, x, scale, bias)
+    dx, dscale, dbias = vjp(dy)
+    return {"X@GRAD": [dx], "Scale@GRAD": [dscale], "Bias@GRAD": [dbias]}
+
+
+@register_op("layer_norm")
+def layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    begin = int(attrs.get("begin_norm_axis", 1))
+    eps = attrs.get("epsilon", 1e-5)
+    lead = 1
+    for d in x.shape[:begin]:
+        lead *= d
+    x2 = x.reshape(lead, -1)
+    m = jnp.mean(x2, axis=1, keepdims=True)
+    v = jnp.var(x2, axis=1, keepdims=True)
+    norm = (x2 - m) * jax.lax.rsqrt(v + eps)
+    if "Scale" in ins:
+        norm = norm * ins["Scale"][0].reshape(1, -1)
+    if "Bias" in ins:
+        norm = norm + ins["Bias"][0].reshape(1, -1)
+    return {"Y": [norm.reshape(x.shape)], "Mean": [m.reshape(lead)],
+            "Variance": [v.reshape(lead)]}
+
+
+@register_op("norm")
+def norm(ctx, ins, attrs):
+    """L2-normalize along axis (reference: norm_op.cc)."""
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    eps = attrs.get("epsilon", 1e-12)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n]}
+
+
+@register_op("one_hot", stop_gradient_op=True, nondiff_inputs=("X",))
+def one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    from ..core.ragged import RaggedTensor
+
+    ragged = isinstance(x, RaggedTensor)
+    ids = x.values if ragged else x
+    depth = int(attrs["depth"])
+    flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    out = jax.nn.one_hot(flat, depth, dtype=jnp.float32)
+    if ragged:
+        return {"Out": [x.with_values(out)]}
+    return {"Out": [out]}

@@ -1,0 +1,4 @@
+"""Reader decorators (reference: python/paddle/v2/reader/)."""
+
+from .decorator import *  # noqa: F401,F403
+from .decorator import __all__  # noqa: F401

@@ -1,0 +1,33 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX import so
+multi-chip sharding tests run without TPU hardware (the driver separately
+dry-runs the multichip path)."""
+
+import os
+
+# force CPU: the session env may point JAX_PLATFORMS at the single real
+# TPU (axon tunnel); tests must never contend for it
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs/scope (the reference's tests
+    run one per process; ours share a process)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+
+    old_main = framework.switch_main_program(framework.Program())
+    old_startup = framework.switch_startup_program(framework.Program())
+    old_scope = scope_mod._global_scope
+    scope_mod._global_scope = scope_mod.Scope()
+    yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    scope_mod._global_scope = old_scope

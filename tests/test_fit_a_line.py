@@ -1,0 +1,59 @@
+"""Milestone A: linear regression end-to-end.
+
+Parity target: reference python/paddle/v2/fluid/tests/book/
+test_fit_a_line.py — same program structure (fc -> square_error_cost ->
+mean -> SGD), loss must fall below threshold.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fit_a_line(tmp_path):
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(x=cost)
+
+    sgd_optimizer = fluid.optimizer.SGD(learning_rate=0.01)
+    sgd_optimizer.minimize(avg_cost)
+
+    BATCH_SIZE = 20
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500),
+        batch_size=BATCH_SIZE)
+
+    place = fluid.CPUPlace()
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    exe = fluid.Executor(place)
+
+    exe.run(fluid.default_startup_program())
+
+    first_loss = None
+    last_loss = None
+    for pass_id in range(30):
+        for data in train_reader():
+            avg_loss_value, = exe.run(fluid.default_main_program(),
+                                      feed=feeder.feed(data),
+                                      fetch_list=[avg_cost])
+            if first_loss is None:
+                first_loss = float(avg_loss_value[0])
+            last_loss = float(avg_loss_value[0])
+        if last_loss < 0.05:
+            break
+    assert last_loss < first_loss, (first_loss, last_loss)
+    assert last_loss < 0.15, last_loss
+
+    # save/load persistables roundtrip (reference test does this each pass)
+    model_dir = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_persistables(exe, model_dir)
+    fluid.io.load_persistables(exe, model_dir)
+    again, = exe.run(fluid.default_main_program(),
+                     feed=feeder.feed(next(train_reader())),
+                     fetch_list=[avg_cost])
+    assert np.isfinite(again[0])

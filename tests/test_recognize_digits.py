@@ -1,0 +1,89 @@
+"""Milestone B: MNIST MLP + conv nets.
+
+Parity target: reference python/paddle/v2/fluid/tests/book/
+test_recognize_digits.py (mlp and conv variants; loss falls, accuracy
+rises on the synthetic class-templated MNIST stand-in).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def loss_net(hidden, label):
+    prediction = fluid.layers.fc(input=hidden, size=10, act="softmax")
+    loss = fluid.layers.cross_entropy(input=prediction, label=label)
+    return fluid.layers.mean(x=loss), fluid.layers.accuracy(
+        input=prediction, label=label)
+
+
+def mlp(img, label):
+    hidden = fluid.layers.fc(input=img, size=128, act="tanh")
+    hidden = fluid.layers.fc(input=hidden, size=128, act="tanh")
+    return loss_net(hidden, label)
+
+
+def conv_net(img, label):
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    return loss_net(conv_pool_2, label)
+
+
+@pytest.mark.parametrize("nn_type", ["mlp", "conv"])
+def test_recognize_digits(nn_type):
+    if nn_type == "mlp":
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    else:
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    net = mlp if nn_type == "mlp" else conv_net
+    avg_loss, acc = net(img, label)
+
+    test_program = fluid.default_main_program().clone()
+
+    optimizer = fluid.optimizer.Adam(learning_rate=0.002)
+    optimizer.minimize(avg_loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    BATCH = 64
+
+    def train_rd():
+        for sample in paddle.batch(
+                paddle.reader.shuffle(paddle.dataset.mnist.train(),
+                                      buf_size=500),
+                batch_size=BATCH)():
+            if nn_type == "conv":
+                sample = [(np.reshape(s[0], (1, 28, 28)), s[1])
+                          for s in sample]
+            yield sample
+
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=place)
+
+    losses, accs = [], []
+    for pass_id in range(6):
+        for data in train_rd():
+            loss_v, acc_v = exe.run(fluid.default_main_program(),
+                                    feed=feeder.feed(data),
+                                    fetch_list=[avg_loss, acc])
+            losses.append(float(loss_v[0]))
+            accs.append(float(acc_v[0]))
+
+    last_acc = np.mean(accs[-8:])
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert last_acc > 0.9, last_acc
+
+    # test program (cloned before optimizer) must run without updating
+    data = next(iter(train_rd()))
+    tl, ta = exe.run(test_program, feed=feeder.feed(data),
+                     fetch_list=[avg_loss, acc])
+    assert np.isfinite(tl[0])
