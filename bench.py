@@ -1,0 +1,76 @@
+"""Benchmark: ResNet-50 training throughput, batch 128, one chip.
+
+Mirrors the reference benchmark config (reference:
+benchmark/paddle/image/resnet.py + run.sh — ResNet-50, batch 128) on the
+BASELINE.json north-star metric.  vs_baseline is measured against the only
+published in-tree ResNet-50 train number: 82.35 img/s at batch 128 on
+2x Xeon 6148 (reference: benchmark/IntelOptimizedPaddle.md:39-44); the
+north star is P40-class GPU throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 82.35  # ResNet-50 batch128, IntelOptimizedPaddle.md
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.jit import FunctionalProgram, state_from_scope
+    from __graft_entry__ import _build_resnet50
+
+    main_prog, startup, logits, avg_loss = _build_resnet50(
+        batch, image_size, 1000, with_loss=True)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+
+    fp = FunctionalProgram(main_prog, ["image", "label"], [avg_loss.name])
+    state = state_from_scope(fp, scope)
+    dev = jax.devices()[0]
+    state = {n: jax.device_put(np.asarray(v), dev)
+             for n, v in state.items()}
+
+    step = jax.jit(lambda s, f: fp(s, f), donate_argnums=(0,))
+
+    rs = np.random.RandomState(0)
+    image = jax.device_put(
+        rs.rand(batch, 3, image_size, image_size).astype(np.float32), dev)
+    label = jax.device_put(
+        rs.randint(0, 1000, size=(batch, 1)).astype(np.int64), dev)
+    feeds = {"image": image, "label": label}
+
+    for _ in range(warmup):
+        fetches, state = step(state, feeds)
+    jax.block_until_ready(fetches)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fetches, state = step(state, feeds)
+    jax.block_until_ready(fetches)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_batch%d" % batch,
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

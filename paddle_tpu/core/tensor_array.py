@@ -1,0 +1,85 @@
+"""TensorArray: fixed-capacity dense array-of-tensors (LoDTensorArray).
+
+TPU-native re-design of the reference's LoDTensorArray
+(reference: paddle/framework/lod_tensor_array.h, tensor_array_read_write
+ops paddle/operators/tensor_array_read_write_op.cc).  The reference grows
+a std::vector<LoDTensor> dynamically; under XLA all shapes are static, so
+a TensorArray is a dense [capacity, ...] buffer + a scalar length, written
+with dynamic_update_slice.  This is what makes write/read usable as a
+lax.while_loop / scan carry (beam-search decode, DynamicRNN outputs).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TensorArray", "EmptyTensorArray", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 256
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """buffer: [capacity, ...elem_shape]; length: scalar int32 (number of
+    valid entries = max written index + 1)."""
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = jnp.asarray(length, jnp.int32)
+
+    def tree_flatten(self):
+        return ((self.buffer, self.length), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = object.__new__(cls)
+        obj.buffer, obj.length = children
+        return obj
+
+    @property
+    def capacity(self):
+        return self.buffer.shape[0]
+
+    def write(self, i, value):
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        buf = jax.lax.dynamic_update_slice(
+            self.buffer, value[None], (i,) + (0,) * (self.buffer.ndim - 1))
+        return TensorArray(buf, jnp.maximum(self.length, i + 1))
+
+    def read(self, i):
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        return jax.lax.dynamic_slice(
+            self.buffer, (i,) + (0,) * (self.buffer.ndim - 1),
+            (1,) + self.buffer.shape[1:])[0]
+
+    def stack(self):
+        """Dense [capacity, ...] view (entries past length are zeros)."""
+        mask = (jnp.arange(self.capacity) < self.length)
+        return jnp.where(
+            mask.reshape((-1,) + (1,) * (self.buffer.ndim - 1)),
+            self.buffer, jnp.zeros_like(self.buffer))
+
+    @staticmethod
+    def from_elem(elem, capacity=DEFAULT_CAPACITY):
+        buf = jnp.zeros((capacity,) + tuple(elem.shape), elem.dtype)
+        return TensorArray(buf, 0)
+
+    def __repr__(self):
+        return "TensorArray(capacity=%d, elem=%s%s)" % (
+            self.capacity, self.buffer.shape[1:], self.buffer.dtype)
+
+
+class EmptyTensorArray:
+    """Placeholder for an array created but never written (host-side only;
+    cannot cross into a jitted loop carry — first-write must happen before
+    the loop, matching the reference decode pattern where init ids are
+    written before entering the while block)."""
+
+    def __init__(self, capacity=DEFAULT_CAPACITY):
+        self.capacity = capacity
+
+    def write(self, i, value):
+        arr = TensorArray.from_elem(value, self.capacity)
+        return arr.write(i, value)
+
+    def __repr__(self):
+        return "EmptyTensorArray(capacity=%d)" % self.capacity

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..core.scope import Scope, global_scope
 from ..core.ragged import RaggedTensor, SelectedRows
-from ..core.types import np_dtype
+from ..core.types import np_dtype, VarType
 from ..ops import registry as op_registry
 from . import framework
 
@@ -148,8 +148,23 @@ def _env_get(ctx, name):
     env = ctx.env
     if name in env:
         return env[name]
+    # a TensorArray read before any write is legal (first array_write
+    # creates it); everything else must be fed/persistable/produced
+    vd = _find_var_desc_or_none(ctx.program, ctx.block_idx, name)
+    if vd is not None and vd.type == VarType.TENSOR_ARRAY:
+        return None
     raise KeyError("variable %r is not initialized (op inputs must be fed, "
                    "persistable, or produced earlier in the block)" % name)
+
+
+def _find_var_desc_or_none(program, block_idx, name):
+    bd = program.desc.block(block_idx)
+    while True:
+        if name in bd.vars:
+            return bd.vars[name]
+        if bd.parent_idx < 0:
+            return None
+        bd = program.desc.block(bd.parent_idx)
 
 
 def apply_op(ctx, op_desc):

@@ -67,8 +67,13 @@ class LayerHelper:
         if len(attr) != 1 and len(attr) != length:
             raise ValueError("parameter number mismatch")
         if len(attr) == 1 and length != 1:
-            attr = [attr[0]] + [
-                ParamAttr(**attr[0].to_kwargs()) for _ in range(length - 1)]
+            a0 = attr[0]
+            attr = [a0] + [
+                ParamAttr(name=None, initializer=a0.initializer,
+                          learning_rate=a0.learning_rate,
+                          regularizer=a0.regularizer, trainable=a0.trainable,
+                          gradient_clip=a0.gradient_clip)
+                for _ in range(length - 1)]
         return attr
 
     def iter_inputs_and_params(self, input_param_name="input"):

@@ -1,0 +1,314 @@
+"""Control-flow ops: while / conditional_block / recurrent / tensor arrays.
+
+TPU-native re-design of the reference's interpreted control flow:
+  * while_op.cc:35 runs its sub-block via a nested Executor per iteration;
+    here the sub-block is *lowered in-trace* into lax.while_loop (unbounded,
+    non-differentiable — generation/decode) or lax.scan with an active-mask
+    (attrs["max_steps"] set — bounded, reverse-differentiable), so XLA
+    compiles the whole loop.
+  * conditional_block_op.cc -> lax.cond over an env-carry.
+  * recurrent_op.cc (the StaticRNN engine, + RecurrentGradientMachine's
+    per-timestep expansion) -> one lax.scan over time-major step inputs
+    with memory carries and optional per-step mask (variable-length
+    sequences; replaces the reference's dynamic graph expansion).
+  * tensor_array_read_write_op.cc / lod_array_length_op.cc over the dense
+    fixed-capacity TensorArray (core/tensor_array.py).
+
+Grad strategy: recurrent and bounded-while differentiate through the
+generic jax.vjp path (registry.run_generic_grad) — XLA's scan transpose
+replaces the reference's hand-built sub-block backward
+(backward.cc:415 MakeBlockBackward, while_op.cc:93 WhileGradOp).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.tensor_array import TensorArray, EmptyTensorArray, \
+    DEFAULT_CAPACITY
+
+
+def _sub_ctx(ctx, block_idx, env):
+    from ..fluid.executor import ExecContext
+
+    return ExecContext(None, ctx.program, block_idx, env, rng=None)
+
+
+def _run_block(ctx, block_idx, env):
+    from ..fluid.executor import apply_op
+
+    sub = _sub_ctx(ctx, block_idx, env)
+    block_desc = ctx.program.desc.block(block_idx)
+    for od in block_desc.ops:
+        apply_op(sub, od)
+    return env
+
+
+def _scalar_bool(v):
+    return jnp.asarray(v).reshape(()).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+@register_op("while", nondiff_inputs=("Condition",))
+def while_op(ctx, ins, attrs):
+    """reference: while_op.cc:35.  attrs:
+      sub_block: BlockRef; x_names: names for ins["X"] (closure + carried
+      initial values); carry_names: loop-state var names (written in the
+      block; must exist among x_names); cond_name: condition var name;
+      max_steps: if set, lower to scan (differentiable, bounded)."""
+    blk = attrs["sub_block"].idx
+    x_names = list(attrs["x_names"])
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    max_steps = attrs.get("max_steps")
+
+    closure = dict(zip(x_names, ins["X"]))
+    missing = [n for n in carry_names if n not in closure]
+    if missing:
+        raise RuntimeError(
+            "while: loop vars %s have no initial value before the loop "
+            "(initialize them — e.g. first array_write — outside)" % missing)
+    init = {n: closure[n] for n in carry_names}
+    for a in init.values():
+        if isinstance(a, EmptyTensorArray):
+            raise RuntimeError(
+                "while: a TensorArray carried through the loop must be "
+                "written once before the loop (static shapes)")
+
+    def body_env(carry):
+        env = dict(closure)
+        env.update(carry)
+        _run_block(ctx, blk, env)
+        return {n: env[n] for n in carry_names}
+
+    if max_steps is None:
+        final = lax.while_loop(
+            lambda c: _scalar_bool(c[cond_name]), body_env, init)
+    else:
+        def scan_body(carry, _):
+            active = _scalar_bool(carry[cond_name])
+            new = body_env(carry)
+            merged = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(active, a, b), new, carry)
+            return merged, None
+
+        final, _ = lax.scan(scan_body, init, None, length=int(max_steps))
+
+    return {"Out": [final[n] for n in carry_names]}
+
+
+def _while_infer_shape(block, op_desc):
+    # loop vars keep their pre-loop meta (same names in and out)
+    return None
+
+
+from .registry import get_op_info as _gi
+
+_gi("while").infer_shape = _while_infer_shape
+
+
+# ---------------------------------------------------------------------------
+# conditional_block
+# ---------------------------------------------------------------------------
+
+@register_op("conditional_block", nondiff_inputs=("Cond",))
+def conditional_block(ctx, ins, attrs):
+    """reference: conditional_block_op.cc.  Runs the sub-block iff the
+    scalar condition holds; written vars fall back to their outer values
+    (which must exist) when it doesn't.  attrs: sub_block, x_names,
+    out_names, is_scalar_condition."""
+    blk = attrs["sub_block"].idx
+    x_names = list(attrs["x_names"])
+    out_names = list(attrs["out_names"])
+    cond = ins["Cond"][0]
+    if attrs.get("is_scalar_condition", True):
+        pred = _scalar_bool(cond)
+    else:
+        pred = jnp.asarray(cond).any()
+
+    closure = dict(zip(x_names, ins["X"]))
+    missing = [n for n in out_names if n not in closure]
+    if missing:
+        raise RuntimeError(
+            "conditional_block: outputs %s need outer initial values "
+            "(the false branch keeps them)" % missing)
+
+    def true_fn(cl):
+        env = dict(cl)
+        _run_block(ctx, blk, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(cl):
+        return tuple(cl[n] for n in out_names)
+
+    outs = lax.cond(pred, true_fn, false_fn, closure)
+    return {"Out": list(outs)}
+
+
+_gi("conditional_block").infer_shape = lambda block, od: None
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN / DynamicRNN engine)
+# ---------------------------------------------------------------------------
+
+@register_op("recurrent")
+def recurrent(ctx, ins, attrs):
+    """One scan over time.  reference: recurrent_op.cc (StaticRNN) and
+    RecurrentGradientMachine.h:32 (dynamic per-timestep expansion) — both
+    become a single lax.scan with masked memory carries.
+
+    inputs:
+      StepInputs: time-major [T, B, ...] tensors, one per step-input name
+      Boot: initial memory values, one per memory
+      Closure: external reads (weights etc.)
+      Mask: optional [T, B] float/bool validity mask
+    attrs:
+      sub_block; step_input_names; closure_names;
+      mem_pre_names / mem_post_names (parallel lists);
+      step_output_names; has_mask
+    outputs:
+      StepOutputs: stacked [T, B, ...] per step-output (masked rows zero)
+      FinalMems: memory values after each sequence's last valid step
+    """
+    blk = attrs["sub_block"].idx
+    step_in_names = list(attrs["step_input_names"])
+    closure_names = list(attrs["closure_names"])
+    pre_names = list(attrs["mem_pre_names"])
+    post_names = list(attrs["mem_post_names"])
+    out_names = list(attrs["step_output_names"])
+    has_mask = bool(attrs.get("has_mask", False))
+
+    xs = list(ins.get("StepInputs", []))
+    boots = list(ins.get("Boot", []))
+    closure = dict(zip(closure_names, ins.get("Closure", [])))
+    mask = ins["Mask"][0] if has_mask else None
+
+    def body(mems, xt):
+        xs_t = xt[:-1] if has_mask else xt
+        m_t = xt[-1] if has_mask else None
+        env = dict(closure)
+        for n, v in zip(step_in_names, xs_t):
+            env[n] = v
+        for n, v in zip(pre_names, mems):
+            env[n] = v
+        _run_block(ctx, blk, env)
+        new_mems = [env[n] for n in post_names]
+        outs_t = [env[n] for n in out_names]
+        if m_t is not None:
+            def keep(new, old):
+                m = m_t.astype(bool).reshape(
+                    m_t.shape + (1,) * (new.ndim - m_t.ndim))
+                return jnp.where(m, new, old)
+
+            new_mems = [keep(n_, o_) for n_, o_ in zip(new_mems, mems)]
+            outs_t = [
+                jnp.where(
+                    m_t.astype(bool).reshape(
+                        m_t.shape + (1,) * (o.ndim - m_t.ndim)),
+                    o, jnp.zeros_like(o))
+                for o in outs_t]
+        return tuple(new_mems), tuple(outs_t)
+
+    scan_xs = tuple(xs) + ((mask,) if has_mask else ())
+    final_mems, step_outs = lax.scan(body, tuple(boots), scan_xs)
+    return {"StepOutputs": list(step_outs), "FinalMems": list(final_mems)}
+
+
+def _recurrent_infer_shape(block, op_desc):
+    from ..fluid.framework import _find_var_desc
+
+    T = None
+    for n in op_desc.input("StepInputs"):
+        vd = _find_var_desc(block, n)
+        T = vd.shape[0] if vd.shape else None
+        break
+    for slot_in, slot_out in (("Boot", "FinalMems"),):
+        for bn, on in zip(op_desc.input(slot_in), op_desc.output(slot_out)):
+            src = _find_var_desc(block, bn)
+            dst = _find_var_desc(block, on)
+            dst.shape, dst.dtype, dst.lod_level = src.shape, src.dtype, 0
+    # step outputs: [T] + sub-block var meta
+    prog = block.program
+    sub_idx = op_desc.attrs["sub_block"].idx
+    sub_bd = prog.desc.block(sub_idx)
+    for name, out_n in zip(op_desc.attrs["step_output_names"],
+                           op_desc.output("StepOutputs")):
+        dst = _find_var_desc(block, out_n)
+        if name in sub_bd.vars:
+            sv = sub_bd.vars[name]
+            dst.shape = (T if T is not None else -1,) + tuple(sv.shape or ())
+            dst.dtype = sv.dtype
+            dst.lod_level = 0
+
+
+_gi("recurrent").infer_shape = _recurrent_infer_shape
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (reference: tensor_array_read_write_op.cc,
+# lod_array_length_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("write_to_array", nondiff_inputs=("I",))
+def write_to_array(ctx, ins, attrs):
+    x = ins["X"][0]
+    i = ins["I"][0]
+    arr = ins.get("Array", [None])[0]
+    if arr is None:
+        arr = EmptyTensorArray(attrs.get("capacity", DEFAULT_CAPACITY))
+    return {"Out": [arr.write(i, x)]}
+
+
+@register_op("read_from_array", nondiff_inputs=("I",))
+def read_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    i = ins["I"][0]
+    if isinstance(arr, EmptyTensorArray):
+        raise RuntimeError("read_from_array on an empty TensorArray")
+    return {"Out": [arr.read(i)]}
+
+
+@register_op("lod_array_length", stop_gradient_op=True)
+def lod_array_length(ctx, ins, attrs):
+    arr = ins["X"][0]
+    if isinstance(arr, EmptyTensorArray):
+        return {"Out": [jnp.zeros((1,), jnp.int64)]}
+    return {"Out": [arr.length.reshape((1,)).astype(jnp.int64)]}
+
+
+@register_op("max_sequence_len", stop_gradient_op=True)
+def max_sequence_len(ctx, ins, attrs):
+    """reference: max_sequence_len_op.cc (max len from a rank table);
+    here: from a RaggedTensor's splits."""
+    rt = ins["RankTable"][0]
+    lens = rt.seq_lengths() if hasattr(rt, "seq_lengths") else rt
+    return {"Out": [jnp.max(lens).reshape((1,)).astype(jnp.int64)]}
+
+
+def _array_infer_shape(block, op_desc):
+    return None
+
+
+for _t in ("write_to_array", "read_from_array", "lod_array_length",
+           "max_sequence_len"):
+    _gi(_t).infer_shape = _array_infer_shape
+
+
+@register_op("get_places", stop_gradient_op=True, jittable=False)
+def get_places(ctx, ins, attrs):
+    """reference: get_places_op.cc — device enumeration for parallel_do;
+    on TPU informational only (the Mesh owns layout)."""
+    import jax
+
+    n = attrs.get("device_count") or 0
+    avail = len(jax.devices())
+    n = avail if n <= 0 else min(n, avail)
+    return {"Out": [jnp.arange(n, dtype=jnp.int32)]}
+
+
+_gi("get_places").infer_shape = lambda block, od: None

@@ -403,3 +403,60 @@ def gru_unit(ctx, ins, attrs):
     h = u * h_prev + (1 - u) * c
     gate = jnp.concatenate([u, r, c], axis=1)
     return {"Gate": [gate], "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}
+
+
+@register_op("sequence_to_dense")
+def sequence_to_dense(ctx, ins, attrs):
+    """Ragged [T, ...] -> padded dense [B, maxT, ...] + float mask [B, maxT].
+    The bridge from LoD-world into the scan-based `recurrent` engine
+    (replaces reference operators/math/sequence2batch.h's reordering)."""
+    x = ins["X"][0]
+    padded, lens = ragged_to_padded(x)
+    T = padded.shape[1]
+    mask = (jnp.arange(T, dtype=jnp.int32)[None, :]
+            < lens[:, None]).astype(jnp.float32)
+    return {"Out": [padded], "Mask": [mask]}
+
+
+def _sequence_to_dense_infer(block, op_desc):
+    from ..fluid.framework import _find_var_desc
+
+    xv = _find_var_desc(block, op_desc.input("X")[0])
+    out = _find_var_desc(block, op_desc.output("Out")[0])
+    mask = _find_var_desc(block, op_desc.output("Mask")[0])
+    out.shape = (-1, -1) + tuple(xv.shape[1:] if xv.shape else ())
+    out.dtype = xv.dtype
+    out.lod_level = 0
+    mask.shape = (-1, -1)
+    mask.dtype = "float32"
+    mask.lod_level = 0
+
+
+from .registry import get_op_info as _gi_seq
+
+_gi_seq("sequence_to_dense").infer_shape = _sequence_to_dense_infer
+
+
+@register_op("dense_to_sequence")
+def dense_to_sequence(ctx, ins, attrs):
+    """Padded dense [B, maxT, ...] -> ragged with Like's row splits."""
+    x = ins["X"][0]
+    like = ins["Like"][0]
+    tpl = RaggedTensor(
+        jnp.zeros((like.values.shape[0],) + tuple(x.shape[2:]), x.dtype),
+        like.row_splits, like.nvalid)
+    return {"Out": [padded_to_ragged(x, tpl)]}
+
+
+def _dense_to_sequence_infer(block, op_desc):
+    from ..fluid.framework import _find_var_desc
+
+    xv = _find_var_desc(block, op_desc.input("X")[0])
+    like = _find_var_desc(block, op_desc.input("Like")[0])
+    out = _find_var_desc(block, op_desc.output("Out")[0])
+    out.shape = (-1,) + tuple(xv.shape[2:] if xv.shape else ())
+    out.dtype = xv.dtype
+    out.lod_level = like.lod_level
+
+
+_gi_seq("dense_to_sequence").infer_shape = _dense_to_sequence_infer

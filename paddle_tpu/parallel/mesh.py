@@ -1,0 +1,56 @@
+"""Device mesh construction.
+
+Replaces the reference's device enumeration + communicator setup
+(reference: operators/get_places_op.cc, operators/nccl/nccl_gpu_common.h:35
+platform::Communicator, MultiGradientMachine device threads).  A Mesh with
+named axes is the TPU-native "communicator": collectives are implied by
+shardings over its axes and ride ICI.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "MeshConfig"]
+
+
+class MeshConfig:
+    """Axis layout for a training job.
+
+    dp: data parallel (batch) — gradient all-reduce rides this axis.
+    mp: model/tensor parallel — weight shards; matmul partials reduce here.
+    Extended axes (pp pipeline, sp sequence) are carved out of the same
+    device list by callers that need them.
+    """
+
+    def __init__(self, dp=None, mp=1, axes=("dp", "mp")):
+        self.dp = dp
+        self.mp = mp
+        self.axes = tuple(axes)
+
+
+def make_mesh(n_devices=None, dp=None, mp=1, axes=("dp", "mp"),
+              devices=None):
+    """Build a Mesh of `n_devices` with shape (dp, mp).
+
+    dp defaults to n_devices // mp.  With mp=1 this is pure data
+    parallelism (the MultiGradientMachine/parallel_do capability); mp>1
+    shards weights (tensor parallelism — new capability beyond the
+    reference's per-layer ParallelNeuralNetwork placement).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if dp is None:
+        if n_devices % mp != 0:
+            raise ValueError("n_devices %d not divisible by mp %d"
+                             % (n_devices, mp))
+        dp = n_devices // mp
+    if dp * mp != n_devices:
+        raise ValueError("dp*mp (%d*%d) != n_devices %d"
+                         % (dp, mp, n_devices))
+    dev_array = np.array(devices).reshape(dp, mp)
+    return Mesh(dev_array, axis_names=tuple(axes))

@@ -1,0 +1,70 @@
+"""Sharding specs for program state and feeds.
+
+The reference decides placement imperatively (scatter params to device
+threads, MultiGradientMachine.h:100-140; split LoDTensor across places,
+parallel_do_op.cc:37-47).  Here placement is declarative: every buffer
+gets a NamedSharding over the mesh and XLA GSPMD partitions the program.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["param_spec", "batch_spec", "replicated", "shard_state",
+           "shard_feeds"]
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def param_spec(name, shape, mesh, mp_axis="mp", min_shard_dim=512):
+    """Default tensor-parallel layout for a parameter.
+
+    Large 2-D weights (fc/projection) shard their output dim over mp;
+    large embedding tables shard the vocab dim over mp (row-sharded like
+    the reference's blockwise pserver partitioning,
+    reference: pserver/ParameterServer2.h:73, distribute_transpiler.py:39);
+    everything else (conv filters, biases, BN stats) is replicated — conv
+    weights are small relative to activations, and replication keeps the
+    conv spatially partitionable by dp.
+    """
+    if mp_axis not in mesh.shape:
+        return P()
+    mp = mesh.shape[mp_axis]
+    if mp == 1:
+        return P()
+    if len(shape) == 2:
+        rows, cols = int(shape[0]), int(shape[1])
+        # embedding / big row-major tables: shard rows
+        if rows >= min_shard_dim * mp and rows % mp == 0 and rows >= cols:
+            return P(mp_axis, None)
+        if cols % mp == 0 and cols >= min_shard_dim:
+            return P(None, mp_axis)
+        if rows % mp == 0 and rows >= min_shard_dim:
+            return P(mp_axis, None)
+    return P()
+
+
+def batch_spec(shape, mesh, dp_axis="dp"):
+    """Feeds shard their leading (batch) dim over dp."""
+    if dp_axis not in mesh.shape or len(shape) == 0:
+        return P()
+    return P(dp_axis)
+
+
+def shard_state(state, mesh, var_shapes=None, mp_axis="mp"):
+    """Return {name: NamedSharding} for a state dict (arrays or abstract)."""
+    specs = {}
+    for name, v in state.items():
+        shape = v.shape if hasattr(v, "shape") else var_shapes[name]
+        specs[name] = NamedSharding(mesh, param_spec(name, shape, mesh,
+                                                     mp_axis=mp_axis))
+    return specs
+
+
+def shard_feeds(feeds, mesh, dp_axis="dp"):
+    specs = {}
+    for name, v in feeds.items():
+        specs[name] = NamedSharding(mesh, batch_spec(v.shape, mesh,
+                                                     dp_axis=dp_axis))
+    return specs

@@ -14,6 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import pytest  # noqa: E402
 
+# the axon sitecustomize (PYTHONPATH=/root/.axon_site) force-selects the
+# TPU platform via jax.config at interpreter start, overriding the env
+# var; override it back before any backend initializes
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(autouse=True)
 def fresh_programs():
