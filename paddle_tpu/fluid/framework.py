@@ -425,7 +425,7 @@ def infer_shape_for_op(block, op_desc):
         metas = []
         for n in names:
             vd = _find_var_desc(block, n)
-            metas.append((vd.shape, vd.dtype, vd.lod_level))
+            metas.append((vd.shape, vd.dtype, vd.lod_level, vd.type))
         ins_meta[slot] = metas
     outs = op_registry.generic_infer_shape(op_desc.type, ins_meta,
                                            op_desc.attrs)
@@ -433,11 +433,14 @@ def infer_shape_for_op(block, op_desc):
         metas = outs.get(slot)
         if metas is None:
             continue
-        for n, (shape, dtype, lod) in zip(names, metas):
+        for n, meta in zip(names, metas):
+            (shape, dtype, lod), rest = meta[:3], meta[3:]
             vd = _find_var_desc(block, n)
             vd.shape = shape
             vd.dtype = canonical_dtype(dtype)
             vd.lod_level = lod
+            if rest:
+                vd.type = rest[0]
 
 
 def _grad_op_infer_shape(block, op_desc):

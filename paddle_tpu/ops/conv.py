@@ -58,13 +58,20 @@ def conv2d_transpose(ctx, ins, attrs):
     strides = tuple(attrs.get("strides", [1, 1]))
     paddings = tuple(attrs.get("paddings", [0, 0]))
     dilations = tuple(attrs.get("dilations", [1, 1]))
-    out = lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1),
-        strides=strides,
-        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+    # transposed conv = gradient of conv w.r.t. its input: dilate the
+    # input by `strides`, convolve with the spatially-flipped filter
+    # (reference conv_transpose_op.cc computes it the same way via the
+    # conv backward-data path)
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    out = lax.conv_general_dilated(
+        x, jnp.flip(jnp.swapaxes(w, 0, 1), (2, 3)),
+        window_strides=(1, 1),
+        padding=[(kh - 1 - paddings[0], kh - 1 - paddings[0]),
+                 (kw - 1 - paddings[1], kw - 1 - paddings[1])],
+        lhs_dilation=strides,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return {"Output": [out]}
 
 

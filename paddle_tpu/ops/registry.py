@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.types import is_float_dtype, np_dtype, GRAD_SUFFIX
+from ..core.types import is_float_dtype, np_dtype, GRAD_SUFFIX, VarType
 
 
 class OpInfo:
@@ -129,9 +129,9 @@ class _NullCtx:
 
 
 def _abstract_inputs(ins_meta, prime):
-    """ins_meta: slot -> list of (shape, dtype, lod_level).  Returns abstract
-    values with every -1 dim substituted by `prime`."""
-    from ..core.ragged import RaggedTensor
+    """ins_meta: slot -> list of (shape, dtype, lod_level[, var_type]).
+    Returns abstract values with every -1 dim substituted by `prime`."""
+    from ..core.ragged import RaggedTensor, SelectedRows
 
     def sub(shape):
         return tuple(prime if (d is None or d < 0) else int(d)
@@ -140,7 +140,19 @@ def _abstract_inputs(ins_meta, prime):
     abstract = {}
     for slot, metas in ins_meta.items():
         vals = []
-        for (shape, dtype, lod_level) in metas:
+        for meta in metas:
+            (shape, dtype, lod_level), rest = meta[:3], meta[3:]
+            vtype = rest[0] if rest else VarType.DENSE_TENSOR
+            if vtype == VarType.SELECTED_ROWS:
+                # rows count is dynamic; height = shape[0] is static
+                height = int(shape[0]) if shape and shape[0] and \
+                    shape[0] > 0 else prime
+                sr = SelectedRows.tree_unflatten(height, (
+                    jax.ShapeDtypeStruct((prime,), jnp.int32),
+                    jax.ShapeDtypeStruct((prime,) + sub(shape)[1:],
+                                         np_dtype(dtype))))
+                vals.append(sr)
+                continue
             sds = jax.ShapeDtypeStruct(sub(shape), np_dtype(dtype))
             if lod_level and lod_level > 0:
                 splits = [jax.ShapeDtypeStruct((prime + 1,), jnp.int32)
@@ -168,23 +180,32 @@ def generic_infer_shape(op_type, ins_meta, attrs):
     has_dynamic = any(
         (d is None or d < 0)
         for metas in ins_meta.values()
-        for (shape, _, lod) in metas
-        for d in shape) or any(
-        lod > 0 for metas in ins_meta.values() for (_, _, lod) in metas)
+        for meta in metas
+        for d in meta[0]) or any(
+        meta[2] > 0 or (len(meta) > 3 and
+                        meta[3] == VarType.SELECTED_ROWS)
+        for metas in ins_meta.values() for meta in metas)
 
     out_a = run(_PRIME_A)
     out_b = run(_PRIME_B) if has_dynamic else out_a
 
-    from ..core.ragged import RaggedTensor
+    from ..core.ragged import RaggedTensor, SelectedRows
 
     result = {}
     for slot in out_a:
         metas = []
         for va, vb in zip(out_a[slot], out_b[slot]):
+            vtype = VarType.DENSE_TENSOR
             if isinstance(va, RaggedTensor):
                 shape_a, shape_b = va.values.shape, vb.values.shape
                 dtype = va.values.dtype
                 lod = va.lod_level
+            elif isinstance(va, SelectedRows):
+                shape_a = (va.height,) + tuple(va.values.shape[1:])
+                shape_b = (vb.height,) + tuple(vb.values.shape[1:])
+                dtype = va.values.dtype
+                lod = 0
+                vtype = VarType.SELECTED_ROWS
             else:
                 shape_a, shape_b = va.shape, vb.shape
                 dtype = va.dtype
@@ -192,7 +213,7 @@ def generic_infer_shape(op_type, ins_meta, attrs):
             shape = tuple(
                 int(da) if da == db else -1
                 for da, db in zip(shape_a, shape_b))
-            metas.append((shape, jnp.dtype(dtype).name, lod))
+            metas.append((shape, jnp.dtype(dtype).name, lod, vtype))
         result[slot] = metas
     return result
 

@@ -1,0 +1,286 @@
+"""Per-op test harness: output check + numeric-vs-analytic gradient check.
+
+TPU-native equivalent of the reference OpTest base class
+(reference: python/paddle/v2/fluid/tests/op_test.py:212 `OpTest`,
+:97 `get_numeric_gradient`).  Differences by design:
+
+  * the reference runs the raw op twice (CPUPlace/CUDAPlace) through the
+    C++ Scope; here the op runs through the Program -> XLA pipeline on the
+    test platform (virtual CPU devices), which is exactly the production
+    path on TPU.
+  * the numeric/analytic comparison is a Jacobian-vector-product check:
+    loss = sum(w * out) for a fixed random w per checked output; analytic
+    grads come from `calc_gradient` with w as the seed (reference seeds
+    with ones via fill_constant), numeric grads from central differences
+    of the same loss.  This checks the same quantity with a stronger
+    (non-uniform) probe.
+
+Input/output slot values accept the reference conventions:
+  arr                      -> dense tensor
+  (arr, lod)               -> ragged tensor (LoD offsets, reference format)
+  [(name, arr), ...]       -> multi-variable slot (e.g. `sum`)
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.backward import calc_gradient
+from paddle_tpu.core.ragged import RaggedTensor
+
+
+def _as_ragged(arr, lod):
+    return RaggedTensor(np.asarray(arr), [np.asarray(l, np.int64)
+                                          for l in lod])
+
+
+def _norm_slot(slot, val):
+    """-> list of (var_name, feed_value, lod_level)."""
+    if isinstance(val, list) and val and isinstance(val[0], tuple) \
+            and isinstance(val[0][0], str):
+        out = []
+        for name, v in val:
+            if isinstance(v, tuple):
+                out.append((name, _as_ragged(v[0], v[1]), len(v[1])))
+            else:
+                out.append((name, np.asarray(v), 0))
+        return out
+    if isinstance(val, tuple):
+        return [(slot, _as_ragged(val[0], val[1]), len(val[1]))]
+    return [(slot, np.asarray(val), 0)]
+
+
+def _np_dtype_str(arr):
+    d = np.asarray(arr).dtype
+    return str(d)
+
+
+class OpTest:
+    """Subclasses set: op_type, inputs, outputs, attrs (optional)."""
+
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    # -- program construction ------------------------------------------------
+
+    def _build(self):
+        """Fresh program with the single op; returns (prog, feeds,
+        out_slot_to_names, in_entries)."""
+        prog = framework.Program()
+        block = prog.global_block()
+        feeds = {}
+        in_vars = {}
+        in_entries = {}  # var name -> feed value
+        for slot, val in self.inputs.items():
+            entries = _norm_slot(slot, val)
+            names = []
+            for name, feed_val, lod_level in entries:
+                vals = feed_val.values if isinstance(feed_val, RaggedTensor) \
+                    else feed_val
+                v = block.create_var(
+                    name=name, shape=list(np.asarray(vals).shape),
+                    dtype=_np_dtype_str(vals), lod_level=lod_level)
+                v.stop_gradient = False
+                feeds[name] = feed_val
+                in_entries[name] = feed_val
+                names.append(name)
+            in_vars[slot] = [block.var(n) for n in names]
+        out_vars = {}
+        out_names = {}
+        for slot, val in self.outputs.items():
+            entries = _norm_slot(slot, val)
+            vs = []
+            for name, ref_val, lod_level in entries:
+                vals = ref_val.values if isinstance(ref_val, RaggedTensor) \
+                    else ref_val
+                v = block.create_var(
+                    name=name, shape=list(np.asarray(vals).shape),
+                    dtype=_np_dtype_str(vals), lod_level=lod_level)
+                vs.append(v)
+            out_vars[slot] = vs
+            out_names[slot] = [v.name for v in vs]
+        block.append_op(type=self.op_type, inputs=in_vars,
+                        outputs=out_vars, attrs=dict(self.attrs or {}))
+        return prog, feeds, out_names, in_entries
+
+    def _exe(self):
+        return fluid.Executor(fluid.CPUPlace())
+
+    # -- output check --------------------------------------------------------
+
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=()):
+        prog, feeds, out_names, _ = self._build()
+        exe = self._exe()
+        scope = fluid.Scope()
+        flat_names, refs = [], []
+        for slot, val in self.outputs.items():
+            if slot in no_check_set:
+                continue
+            for (name, ref_val, _), n in zip(_norm_slot(slot, val),
+                                             out_names[slot]):
+                flat_names.append(n)
+                refs.append(ref_val)
+        results = exe.run(prog, feed=feeds, fetch_list=flat_names,
+                          scope=scope, return_numpy=False)
+        for name, ref, got in zip(flat_names, refs, results):
+            if isinstance(ref, RaggedTensor):
+                assert isinstance(got, RaggedTensor), \
+                    "%s: expected ragged, got %r" % (name, type(got))
+                n = int(np.asarray(ref.nvalid))
+                np.testing.assert_allclose(
+                    np.asarray(got.values)[:n], np.asarray(ref.values)[:n],
+                    atol=atol, rtol=rtol,
+                    err_msg="op %s output %s (values)" % (self.op_type, name))
+                for i, (rs_ref, rs_got) in enumerate(
+                        zip(ref.row_splits, got.row_splits)):
+                    np.testing.assert_array_equal(
+                        np.asarray(rs_got), np.asarray(rs_ref),
+                        err_msg="op %s output %s lod level %d"
+                        % (self.op_type, name, i))
+            else:
+                got = np.asarray(got)
+                ref = np.asarray(ref)
+                if ref.dtype.kind in "fc":
+                    np.testing.assert_allclose(
+                        got.astype(np.float64), ref.astype(np.float64),
+                        atol=atol, rtol=rtol,
+                        err_msg="op %s output %s" % (self.op_type, name))
+                else:
+                    np.testing.assert_array_equal(
+                        got, ref,
+                        err_msg="op %s output %s" % (self.op_type, name))
+
+    # -- gradient check ------------------------------------------------------
+
+    def check_grad(self, inputs_to_check, output_names,
+                   max_relative_error=0.005, no_grad_set=None,
+                   numeric_delta=None, atol=None):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        if isinstance(inputs_to_check, str):
+            inputs_to_check = [inputs_to_check]
+
+        # map output *slot or var* names to var names
+        prog, feeds, out_names, in_entries = self._build()
+        block = prog.global_block()
+        flat_out = []
+        for want in output_names:
+            if want in out_names:
+                flat_out.extend(out_names[want])
+            else:
+                flat_out.append(want)
+
+        # fixed probe weights per output
+        rs = np.random.RandomState(2018)
+        weights = {}
+        for n in flat_out:
+            ref = self._lookup_output_ref(n)
+            vals = ref.values if isinstance(ref, RaggedTensor) else ref
+            w = rs.uniform(0.5, 1.5, np.asarray(vals).shape)
+            weights[n] = w.astype(np.asarray(vals).dtype)
+
+        # resolve checked input var names (slot name or var name)
+        check_names = []
+        for want in inputs_to_check:
+            if want in in_entries:
+                check_names.append(want)
+            else:
+                for name, _, _ in _norm_slot(want, self.inputs[want]):
+                    check_names.append(name)
+
+        # analytic: seed each output grad with w
+        wvars = []
+        for n in flat_out:
+            wv = block.create_var(name=n + "@PROBE",
+                                  shape=list(weights[n].shape),
+                                  dtype=_np_dtype_str(weights[n]))
+            wv.stop_gradient = True
+            feeds[n + "@PROBE"] = weights[n]
+            wvars.append(wv)
+        targets = [block.var(n) for n in flat_out]
+        ngs = set(no_grad_set or ())
+        grad_vars = calc_gradient(targets, [block.var(n)
+                                            for n in check_names],
+                                  target_gradients=wvars, no_grad_set=ngs)
+        grad_names = [g.name if isinstance(g, framework.Variable) else g
+                      for g in grad_vars]
+        exe = self._exe()
+        analytic = exe.run(prog, feed=feeds,
+                           fetch_list=[g for g in grad_names if g],
+                           scope=fluid.Scope(), return_numpy=False)
+        analytic_by_name = {}
+        it = iter(analytic)
+        for cn, g in zip(check_names, grad_names):
+            analytic_by_name[cn] = next(it) if g else None
+
+        # numeric: central differences of loss = sum(w * out)
+        fwd_prog, fwd_feeds, fwd_out_names, _ = self._build()
+        fwd_exe = fluid.Executor(fluid.CPUPlace())
+        fwd_scope = fluid.Scope()
+
+        def loss_of(feed_map):
+            outs = fwd_exe.run(fwd_prog, feed=feed_map, fetch_list=flat_out,
+                               scope=fwd_scope, return_numpy=False,
+                               use_program_cache=True)
+            total = 0.0
+            for n, o in zip(flat_out, outs):
+                vals = o.values if isinstance(o, RaggedTensor) else o
+                total += float(np.sum(np.asarray(vals, np.float64)
+                                      * weights[n].astype(np.float64)))
+            return total
+
+        for cn in check_names:
+            base = in_entries[cn]
+            ragged = isinstance(base, RaggedTensor)
+            base_vals = np.asarray(base.values if ragged else base,
+                                   np.float64)
+            delta = numeric_delta or (1e-3 if base_vals.dtype else 1e-3)
+            numeric = np.zeros_like(base_vals)
+            flat = base_vals.reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                for sign in (+1.0, -1.0):
+                    flat[i] = orig + sign * delta
+                    pert = flat.reshape(base_vals.shape).astype(
+                        np.asarray(base.values if ragged else base).dtype)
+                    fm = dict(fwd_feeds)
+                    fm[cn] = RaggedTensor(pert, [np.asarray(r) for r in
+                                                 base.row_splits]) \
+                        if ragged else pert
+                    if sign > 0:
+                        lp = loss_of(fm)
+                    else:
+                        lm = loss_of(fm)
+                flat[i] = orig
+                num_flat[i] = (lp - lm) / (2.0 * delta)
+
+            a = analytic_by_name[cn]
+            assert a is not None, "no analytic grad for %s" % cn
+            a_vals = np.asarray(a.values if isinstance(a, RaggedTensor)
+                                else a, np.float64)
+            self._compare_grad(cn, a_vals, numeric, max_relative_error,
+                               atol)
+
+    def _compare_grad(self, name, analytic, numeric, max_rel, atol):
+        analytic = analytic.reshape(numeric.shape)
+        abs_a = np.abs(analytic)
+        abs_n = np.abs(numeric)
+        scale = np.maximum(np.maximum(abs_a, abs_n), 1e-3 if atol is None
+                           else atol)
+        rel = np.abs(analytic - numeric) / scale
+        max_diff = rel.max() if rel.size else 0.0
+        assert max_diff <= max_rel, (
+            "op %s grad of %s: max relative error %g > %g\nanalytic=%s\n"
+            "numeric=%s" % (self.op_type, name, max_diff, max_rel,
+                            analytic.reshape(-1)[:16],
+                            numeric.reshape(-1)[:16]))
+
+    def _lookup_output_ref(self, var_name):
+        for slot, val in self.outputs.items():
+            for name, ref_val, _ in _norm_slot(slot, val):
+                if name == var_name:
+                    return ref_val
+        raise KeyError(var_name)

@@ -1,0 +1,103 @@
+"""Op tests: activation family (reference: test_activation_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RS = np.random.RandomState(7)
+
+
+def _case(op_type, np_fn, attrs=None, lo=-1.0, hi=1.0, grad=True,
+          max_rel=0.005, avoid=None):
+    class _T(OpTest):
+        def test(self):
+            self.op_type = op_type
+            x = RS.uniform(lo, hi, (4, 5)).astype("float32")
+            if avoid is not None:
+                # push points away from non-differentiable kinks
+                for kink in avoid:
+                    x[np.abs(x - kink) < 0.08] += 0.2
+            self.inputs = {"X": x}
+            self.attrs = attrs or {}
+            self.outputs = {"Out": np_fn(x.astype("float64")).astype(
+                "float32")}
+            self.check_output()
+            if grad:
+                self.check_grad(["X"], "Out", max_relative_error=max_rel)
+    _T.__name__ = "Test" + op_type.title().replace("_", "")
+    return _T
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+TestSigmoid = _case("sigmoid", _sigmoid)
+TestLogsigmoid = _case("logsigmoid", lambda x: np.log(_sigmoid(x)))
+TestExp = _case("exp", np.exp)
+TestRelu = _case("relu", lambda x: np.maximum(x, 0), avoid=[0.0])
+TestTanh = _case("tanh", np.tanh)
+TestTanhShrink = _case("tanh_shrink", lambda x: x - np.tanh(x),
+                       max_rel=0.05)
+TestSqrt = _case("sqrt", np.sqrt, lo=0.2, hi=1.2)
+TestAbs = _case("abs", np.abs, avoid=[0.0])
+TestCeil = _case("ceil", np.ceil, grad=False)
+TestFloor = _case("floor", np.floor, grad=False)
+TestRound = _case("round", np.round, grad=False)
+TestReciprocal = _case("reciprocal", lambda x: 1.0 / x, lo=0.5, hi=1.5)
+TestLog = _case("log", np.log, lo=0.3, hi=1.5)
+TestSquare = _case("square", np.square)
+TestSoftplus = _case("softplus", lambda x: np.log(1 + np.exp(x)))
+TestSoftsign = _case("softsign", lambda x: x / (1 + np.abs(x)))
+TestBRelu = _case("brelu", lambda x: np.clip(x, -0.3, 0.6),
+                  attrs={"t_min": -0.3, "t_max": 0.6},
+                  avoid=[-0.3, 0.6])
+TestLeakyRelu = _case("leaky_relu", lambda x: np.where(x >= 0, x, 0.1 * x),
+                      attrs={"alpha": 0.1}, avoid=[0.0])
+TestElu = _case("elu", lambda x: np.where(x >= 0, x, 1.5 * (np.exp(x) - 1)),
+                attrs={"alpha": 1.5}, avoid=[0.0])
+TestRelu6 = _case("relu6", lambda x: np.clip(x, 0, 6), avoid=[0.0])
+TestPowAct = _case("pow", lambda x: np.power(x, 3.0),
+                   attrs={"factor": 3.0}, lo=0.2, hi=1.2)
+TestSTanh = _case("stanh", lambda x: 1.7159 * np.tanh(2.0 / 3.0 * x),
+                  attrs={"scale_a": 2.0 / 3.0, "scale_b": 1.7159})
+TestSoftshrink = _case(
+    "softshrink",
+    lambda x: np.where(x > 0.4, x - 0.4, np.where(x < -0.4, x + 0.4, 0.0)),
+    attrs={"lambda": 0.4}, avoid=[-0.4, 0.4])
+TestHardShrink = _case(
+    "hard_shrink", lambda x: np.where(np.abs(x) > 0.4, x, 0.0),
+    attrs={"threshold": 0.4}, avoid=[-0.4, 0.4])
+TestThresholdedRelu = _case(
+    "thresholded_relu", lambda x: np.where(x > 0.3, x, 0.0),
+    attrs={"threshold": 0.3}, avoid=[0.3])
+TestHardSigmoid = _case(
+    "hard_sigmoid", lambda x: np.clip(0.3 * x + 0.5, 0, 1),
+    attrs={"slope": 0.3, "offset": 0.5}, grad=False)
+TestSwish = _case("swish", lambda x: x * _sigmoid(2.0 * x),
+                  attrs={"beta": 2.0})
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def test(self):
+        x = RS.uniform(-1, 1, (4, 6)).astype("float32")
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(axis=-1, keepdims=True)}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+class TestPReluOp(OpTest):
+    op_type = "prelu"
+
+    def test(self):
+        x = RS.uniform(-1, 1, (4, 5)).astype("float32")
+        x[np.abs(x) < 0.05] += 0.2
+        alpha = np.asarray([0.25], dtype="float32")
+        self.inputs = {"X": x, "Alpha": alpha}
+        self.outputs = {"Out": np.where(x >= 0, x, 0.25 * x)}
+        self.check_output()
+        self.check_grad(["X", "Alpha"], "Out")

@@ -1,0 +1,333 @@
+"""Op tests: conv/pool/norm family (reference: test_conv2d_op.py,
+test_conv2d_transpose_op.py, test_conv3d_op.py, test_pool2d_op.py,
+test_pool3d_op.py, test_pool_max_op.py, test_batch_norm_op.py,
+test_layer_norm_op, test_lrn_op.py, test_maxout_op.py, test_dropout_op.py,
+test_norm_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RS = np.random.RandomState(3)
+
+
+def _conv2d_ref(x, w, stride, pad, dilation=(1, 1), groups=1):
+    n, cin, h, ww = x.shape
+    cout, cin_g, kh, kw = w.shape
+    eh = (kh - 1) * dilation[0] + 1
+    ew = (kw - 1) * dilation[1] + 1
+    oh = (h + 2 * pad[0] - eh) // stride[0] + 1
+    ow = (ww + 2 * pad[1] - ew) // stride[1] + 1
+    xp = np.pad(x, [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+    out = np.zeros((n, cout, oh, ow), x.dtype)
+    cpg = cout // groups
+    for b in range(n):
+        for oc in range(cout):
+            g = oc // cpg
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[b,
+                               g * cin_g:(g + 1) * cin_g,
+                               i * stride[0]:i * stride[0] + eh:dilation[0],
+                               j * stride[1]:j * stride[1] + ew:dilation[1]]
+                    out[b, oc, i, j] = (patch * w[oc]).sum()
+    return out
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def test(self):
+        x = RS.rand(2, 3, 5, 5).astype("float32")
+        w = RS.rand(4, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.outputs = {"Output": _conv2d_ref(x, w, (1, 1), (1, 1))}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestConv2dStrideGroups(OpTest):
+    op_type = "conv2d"
+
+    def test(self):
+        x = RS.rand(1, 4, 6, 6).astype("float32")
+        w = RS.rand(4, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0], "groups": 2}
+        self.outputs = {"Output": _conv2d_ref(x, w, (2, 2), (0, 0),
+                                              groups=2)}
+        self.check_output(atol=1e-4)
+
+
+class TestConv2dDilation(OpTest):
+    op_type = "conv2d"
+
+    def test(self):
+        x = RS.rand(1, 2, 7, 7).astype("float32")
+        w = RS.rand(3, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [2, 2],
+                      "dilations": [2, 2]}
+        self.outputs = {"Output": _conv2d_ref(x, w, (1, 1), (2, 2),
+                                              dilation=(2, 2))}
+        self.check_output(atol=1e-4)
+
+
+class TestConv2dTranspose(OpTest):
+    op_type = "conv2d_transpose"
+
+    def test(self):
+        x = RS.rand(1, 3, 4, 4).astype("float32")
+        w = RS.rand(3, 2, 3, 3).astype("float32")  # [in_c, out_c, kh, kw]
+        stride, pad = (2, 2), (1, 1)
+        n, cin, h, ww = x.shape
+        _, cout, kh, kw = w.shape
+        oh = (h - 1) * stride[0] - 2 * pad[0] + kh
+        ow = (ww - 1) * stride[1] - 2 * pad[1] + kw
+        out = np.zeros((n, cout, oh + 2 * pad[0], ow + 2 * pad[1]),
+                       x.dtype)
+        for b in range(n):
+            for ic in range(cin):
+                for i in range(h):
+                    for j in range(ww):
+                        out[b, :, i * stride[0]:i * stride[0] + kh,
+                            j * stride[1]:j * stride[1] + kw] += \
+                            x[b, ic, i, j] * w[ic]
+        out = out[:, :, pad[0]:pad[0] + oh, pad[1]:pad[1] + ow]
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": list(stride), "paddings": list(pad)}
+        self.outputs = {"Output": out}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestConv3d(OpTest):
+    op_type = "conv3d"
+
+    def test(self):
+        x = RS.rand(1, 2, 4, 4, 4).astype("float32")
+        w = RS.rand(3, 2, 2, 2, 2).astype("float32")
+        n, cin, d, h, ww = x.shape
+        cout = 3
+        out = np.zeros((1, 3, 3, 3, 3), "float32")
+        for oc in range(cout):
+            for i in range(3):
+                for j in range(3):
+                    for k in range(3):
+                        patch = x[0, :, i:i + 2, j:j + 2, k:k + 2]
+                        out[0, oc, i, j, k] = (patch * w[oc]).sum()
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+        self.outputs = {"Output": out}
+        self.check_output(atol=1e-4)
+
+
+def _pool2d_ref(x, ksize, stride, pad, ptype, exclusive=True):
+    n, c, h, w = x.shape
+    oh = (h + 2 * pad[0] - ksize[0]) // stride[0] + 1
+    ow = (w + 2 * pad[1] - ksize[1]) // stride[1] + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            h0 = i * stride[0] - pad[0]
+            w0 = j * stride[1] - pad[1]
+            h1, w1 = h0 + ksize[0], w0 + ksize[1]
+            h0c, w0c = max(h0, 0), max(w0, 0)
+            h1c, w1c = min(h1, h), min(w1, w)
+            patch = x[:, :, h0c:h1c, w0c:w1c]
+            if ptype == "max":
+                out[:, :, i, j] = patch.max(axis=(2, 3))
+            else:
+                div = (h1c - h0c) * (w1c - w0c) if exclusive \
+                    else ksize[0] * ksize[1]
+                out[:, :, i, j] = patch.sum(axis=(2, 3)) / div
+    return out
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = RS.rand(2, 3, 5, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": _pool2d_ref(x, [2, 2], [2, 2], [0, 0],
+                                           "max")}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+class TestPool2dAvgPadded(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = RS.rand(1, 2, 5, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [3, 3],
+                      "strides": [2, 2], "paddings": [1, 1]}
+        self.outputs = {"Out": _pool2d_ref(x, [3, 3], [2, 2], [1, 1],
+                                           "avg")}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestPool2dGlobal(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = RS.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "global_pooling": True,
+                      "ksize": [1, 1]}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output()
+
+
+class TestPool3d(OpTest):
+    op_type = "pool3d"
+
+    def test(self):
+        x = RS.rand(1, 2, 4, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        out = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+        self.outputs = {"Out": out}
+        self.check_output()
+
+
+class TestMaxPool2dWithIndex(OpTest):
+    op_type = "max_pool2d_with_index"
+
+    def test(self):
+        x = RS.rand(1, 2, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0]}
+        patches = x.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+        flat = patches.reshape(1, 2, 2, 2, 4)
+        out = flat.max(axis=-1)
+        self.outputs = {"Out": out}
+        self.check_output(no_check_set=("Mask",))
+
+
+class TestBatchNormInfer(OpTest):
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 3
+        x = RS.rand(2, c, 4, 4).astype("float32")
+        scale = RS.rand(c).astype("float32") + 0.5
+        bias = RS.rand(c).astype("float32")
+        mean = RS.rand(c).astype("float32")
+        var = RS.rand(c).astype("float32") + 0.5
+        eps = 1e-5
+        ref = (x - mean.reshape(1, c, 1, 1)) / np.sqrt(
+            var.reshape(1, c, 1, 1) + eps) * scale.reshape(1, c, 1, 1) \
+            + bias.reshape(1, c, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": True, "epsilon": eps}
+        self.outputs = {"Y": ref}
+        self.check_output(atol=1e-4)
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 3
+        x = RS.rand(4, c, 3, 3).astype("float32")
+        scale = np.ones(c, "float32")
+        bias = np.zeros(c, "float32")
+        mean = np.zeros(c, "float32")
+        var = np.ones(c, "float32")
+        eps = 1e-5
+        mu = x.mean(axis=(0, 2, 3))
+        sig2 = x.var(axis=(0, 2, 3))
+        ref = (x - mu.reshape(1, c, 1, 1)) / np.sqrt(
+            sig2.reshape(1, c, 1, 1) + eps)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": False, "epsilon": eps, "momentum": 0.9}
+        self.outputs = {"Y": ref}
+        self.check_output(
+            atol=1e-4,
+            no_check_set=("MeanOut", "VarianceOut", "SavedMean",
+                          "SavedVariance"))
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test(self):
+        x = RS.rand(4, 6).astype("float32")
+        scale = RS.rand(6).astype("float32") + 0.5
+        bias = RS.rand(6).astype("float32")
+        eps = 1e-5
+        mu = x.mean(axis=1, keepdims=True)
+        sig2 = x.var(axis=1, keepdims=True)
+        ref = (x - mu) / np.sqrt(sig2 + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Y": ref}
+        self.check_output(atol=1e-4, no_check_set=("Mean", "Variance"))
+
+
+class TestLRN(OpTest):
+    op_type = "lrn"
+
+    def test(self):
+        x = RS.rand(2, 4, 3, 3).astype("float32")
+        n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+        sq = x ** 2
+        c = x.shape[1]
+        den = np.zeros_like(x)
+        for i in range(c):
+            lo, hi = max(0, i - n // 2), min(c, i + n // 2 + 1)
+            den[:, i] = k + alpha * sq[:, lo:hi].sum(axis=1)
+        ref = x / den ** beta
+        self.inputs = {"X": x}
+        self.attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+        self.outputs = {"Out": ref}
+        self.check_output(atol=1e-4, no_check_set=("MidOut",))
+
+
+class TestMaxout(OpTest):
+    op_type = "maxout"
+
+    def test(self):
+        x = RS.rand(2, 6, 3, 3).astype("float32")
+        groups = 3
+        ref = x.reshape(2, 2, 3, 3, 3).max(axis=2)
+        self.inputs = {"X": x}
+        self.attrs = {"groups": groups}
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+
+class TestNormOp(OpTest):
+    op_type = "norm"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32") + 0.1
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "epsilon": 1e-10}
+        norm = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+        self.outputs = {"Out": x / norm}
+        self.check_output(atol=1e-5, no_check_set=("Norm",))
+
+
+class TestDropoutInfer(OpTest):
+    op_type = "dropout"
+
+    def test(self):
+        x = RS.rand(4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.35, "is_test": True}
+        self.outputs = {"Out": x * (1 - 0.35)}
+        self.check_output(no_check_set=("Mask",))

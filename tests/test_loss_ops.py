@@ -1,0 +1,192 @@
+"""Op tests: loss family (reference: test_cross_entropy_op.py,
+test_softmax_with_cross_entropy_op.py, test_sigmoid_cross_entropy_with_
+logits_op.py, test_huber_loss_op.py, test_hinge_loss_op.py,
+test_log_loss_op.py, test_rank_loss_op.py, test_margin_rank_loss_op.py,
+test_modified_huber_loss_op.py, test_smooth_l1_loss_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RS = np.random.RandomState(42)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def test(self):
+        n, c = 5, 4
+        x = _softmax(RS.uniform(-1, 1, (n, c))).astype("float32")
+        label = RS.randint(0, c, (n, 1)).astype("int64")
+        out = -np.log(x[np.arange(n), label.ravel()] + 1e-8)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": out.reshape(n, 1).astype("float32")}
+        self.check_output()
+        self.check_grad(["X"], "Y", max_relative_error=0.05)
+
+
+class TestCrossEntropySoft(OpTest):
+    op_type = "cross_entropy"
+
+    def test(self):
+        n, c = 5, 4
+        x = _softmax(RS.uniform(-1, 1, (n, c))).astype("float32")
+        label = _softmax(RS.uniform(-1, 1, (n, c))).astype("float32")
+        out = (-label * np.log(x + 1e-8)).sum(axis=1, keepdims=True)
+        self.inputs = {"X": x, "Label": label}
+        self.attrs = {"soft_label": True}
+        self.outputs = {"Y": out.astype("float32")}
+        self.check_output()
+        self.check_grad(["X"], "Y", max_relative_error=0.05,
+                        no_grad_set={"Label"})
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        n, c = 5, 4
+        logits = RS.uniform(-1, 1, (n, c)).astype("float32")
+        label = RS.randint(0, c, (n, 1)).astype("int64")
+        sm = _softmax(logits)
+        loss = -np.log(sm[np.arange(n), label.ravel()])
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm.astype("float32"),
+                        "Loss": loss.reshape(n, 1).astype("float32")}
+        self.check_output()
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.05)
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def test(self):
+        x = RS.uniform(-2, 2, (5, 4)).astype("float32")
+        label = RS.randint(0, 2, (5, 4)).astype("float32")
+        sig = 1 / (1 + np.exp(-x))
+        out = -label * np.log(sig) - (1 - label) * np.log(1 - sig)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": out.astype("float32")}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X"], "Out", max_relative_error=0.05,
+                        no_grad_set={"Label"})
+
+
+class TestHingeLoss(OpTest):
+    op_type = "hinge_loss"
+
+    def test(self):
+        logits = RS.uniform(-2, 2, (6, 1)).astype("float32")
+        labels = RS.randint(0, 2, (6, 1)).astype("float32")
+        out = np.maximum(0, 1 - (2 * labels - 1) * logits)
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.outputs = {"Loss": out.astype("float32")}
+        self.check_output()
+        self.check_grad(["Logits"], "Loss", no_grad_set={"Labels"},
+                        max_relative_error=0.01)
+
+
+class TestHuberLoss(OpTest):
+    op_type = "huber_loss"
+
+    def test(self):
+        x = RS.uniform(0, 1, (6, 1)).astype("float32")
+        y = RS.uniform(0, 1, (6, 1)).astype("float32")
+        delta = 0.5
+        r = y - x
+        loss = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                        delta * (np.abs(r) - 0.5 * delta))
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"delta": delta}
+        self.outputs = {"Residual": r.astype("float32"),
+                        "Out": loss.astype("float32")}
+        self.check_output(no_check_set=("Residual",))
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestLogLoss(OpTest):
+    op_type = "log_loss"
+
+    def test(self):
+        eps = 1e-4
+        p = RS.uniform(0.1, 0.9, (6, 1)).astype("float32")
+        l = RS.randint(0, 2, (6, 1)).astype("float32")
+        loss = -l * np.log(p + eps) - (1 - l) * np.log(1 - p + eps)
+        self.inputs = {"Predicted": p, "Labels": l}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Loss": loss.astype("float32")}
+        self.check_output()
+        self.check_grad(["Predicted"], "Loss", no_grad_set={"Labels"},
+                        max_relative_error=0.02)
+
+
+class TestRankLoss(OpTest):
+    op_type = "rank_loss"
+
+    def test(self):
+        label = RS.randint(0, 2, (6, 1)).astype("float32")
+        left = RS.uniform(-1, 1, (6, 1)).astype("float32")
+        right = RS.uniform(-1, 1, (6, 1)).astype("float32")
+        d = left - right
+        out = np.log1p(np.exp(d)) - label * d
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        self.outputs = {"Out": out.astype("float32")}
+        self.check_output()
+        self.check_grad(["Left", "Right"], "Out", no_grad_set={"Label"},
+                        max_relative_error=0.02)
+
+
+class TestMarginRankLoss(OpTest):
+    op_type = "margin_rank_loss"
+
+    def test(self):
+        label = (RS.randint(0, 2, (6, 1)) * 2 - 1).astype("float32")
+        x1 = RS.uniform(-1, 1, (6, 1)).astype("float32")
+        x2 = RS.uniform(-1, 1, (6, 1)).astype("float32")
+        margin = 0.1
+        out = np.maximum(0, -label * (x1 - x2) + margin)
+        self.inputs = {"Label": label, "X1": x1, "X2": x2}
+        self.attrs = {"margin": margin}
+        self.outputs = {"Out": out.astype("float32"),
+                        "Activated": (out > 0).astype("float32")}
+        self.check_output(no_check_set=("Activated",))
+
+
+class TestModifiedHuberLoss(OpTest):
+    op_type = "modified_huber_loss"
+
+    def test(self):
+        x = RS.uniform(-2, 2, (6, 1)).astype("float32")
+        y = RS.randint(0, 2, (6, 1)).astype("float32")
+        z = (2 * y - 1) * x
+        loss = np.where(z < -1, -4 * z,
+                        np.where(z < 1, np.square(1 - z), 0.0))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": z.astype("float32"),
+                        "Out": loss.astype("float32")}
+        self.check_output(no_check_set=("IntermediateVal",))
+
+
+class TestSmoothL1Loss(OpTest):
+    op_type = "smooth_l1_loss"
+
+    def test(self):
+        x = RS.uniform(0, 1, (5, 4)).astype("float32")
+        y = RS.uniform(0, 1, (5, 4)).astype("float32")
+        sigma = 2.0
+        s2 = sigma * sigma
+        d = x - y
+        ad = np.abs(d)
+        val = np.where(ad < 1 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+        out = val.sum(axis=1, keepdims=True)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"sigma": sigma}
+        self.outputs = {"Diff": d.astype("float32"),
+                        "Out": out.astype("float32")}
+        self.check_output(no_check_set=("Diff",))
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)

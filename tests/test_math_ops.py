@@ -1,0 +1,329 @@
+"""Op tests: mul/matmul, elementwise family, reductions, norms.
+
+Parity: reference tests test_mul_op.py, test_elementwise_*_op.py,
+test_reduce_op.py, test_mean_op.py, test_sum_op.py, test_cos_sim_op.py,
+test_squared_l2_norm_op.py, test_l1_norm_op.py, test_minus_op.py,
+test_scale_op.py, test_sign_op.py, test_clip_op.py.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RS = np.random.RandomState(123)
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        y = RS.rand(4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.dot(x, y)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMulOpFlatten(OpTest):
+    """num_col_dims flattening (reference: mul_op.cc x_num_col_dims)."""
+    op_type = "mul"
+
+    def test(self):
+        x = RS.rand(2, 3, 4).astype("float32")
+        y = RS.rand(4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2}
+        self.outputs = {"Out": np.dot(x.reshape(6, 4), y).reshape(2, 3, 5)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def test(self):
+        x = RS.rand(4, 3).astype("float32")
+        y = RS.rand(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": np.dot(x.T, y.T)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulBatched(OpTest):
+    op_type = "matmul"
+
+    def test(self):
+        x = RS.rand(2, 3, 4).astype("float32")
+        y = RS.rand(2, 4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+def _ew_case(op_type, np_fn, grad_ok=True, max_rel=0.005):
+    class _T(OpTest):
+        def test(self):
+            self.op_type = op_type
+            x = RS.rand(3, 4).astype("float32") + 0.5
+            y = RS.rand(3, 4).astype("float32") + 0.5
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": np_fn(x, y)}
+            self.check_output()
+            if grad_ok:
+                self.check_grad(["X", "Y"], "Out",
+                                max_relative_error=max_rel)
+    return _T
+
+
+TestEwAdd = _ew_case("elementwise_add", np.add)
+TestEwSub = _ew_case("elementwise_sub", np.subtract)
+TestEwMul = _ew_case("elementwise_mul", np.multiply)
+TestEwDiv = _ew_case("elementwise_div", np.divide)
+TestEwMax = _ew_case("elementwise_max", np.maximum)
+TestEwMin = _ew_case("elementwise_min", np.minimum)
+# pow's log-term grads amplify float32 central-difference noise
+TestEwPow = _ew_case("elementwise_pow", np.power, max_rel=0.05)
+
+
+class TestEwAddBroadcastAxis(OpTest):
+    """Y broadcast into X at axis (reference: elementwise_op_function.h)."""
+    op_type = "elementwise_add"
+
+    def test(self):
+        x = RS.rand(2, 3, 4).astype("float32")
+        y = RS.rand(3,).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def test(self):
+        x = RS.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": 1, "keep_dim": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanKeepdim(OpTest):
+    op_type = "reduce_mean"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": 0, "keep_dim": True}
+        self.outputs = {"Out": x.mean(axis=0, keepdims=True)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMax(OpTest):
+    op_type = "reduce_max"
+
+    def test(self):
+        x = RS.rand(5, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": 1}
+        self.outputs = {"Out": x.max(axis=1)}
+        self.check_output()
+
+
+class TestReduceAll(OpTest):
+    op_type = "reduce_sum"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.sum())}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestMean(OpTest):
+    op_type = "mean"
+
+    def test(self):
+        x = RS.rand(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean())}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSum(OpTest):
+    op_type = "sum"
+
+    def test(self):
+        xs = [("x%d" % i, RS.rand(3, 4).astype("float32"))
+              for i in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": sum(a for _, a in xs)}
+        self.check_output()
+        self.check_grad(["x0", "x1"], "Out")
+
+
+class TestMinus(OpTest):
+    op_type = "minus"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        y = RS.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5}
+        self.outputs = {"Out": 2.5 * x}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSign(OpTest):
+    op_type = "sign"
+
+    def test(self):
+        x = (RS.rand(3, 4).astype("float32") - 0.5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.sign(x)}
+        self.check_output()
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def test(self):
+        x = RS.uniform(-1, 1, (4, 4)).astype("float32")
+        # keep elements away from the clip boundary for the numeric check
+        x[np.abs(np.abs(x) - 0.5) < 0.05] = 0.0
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.5)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestClipByNorm(OpTest):
+    op_type = "clip_by_norm"
+
+    def test(self):
+        x = RS.rand(4, 4).astype("float32")
+        norm = np.sqrt((x ** 2).sum())
+        self.inputs = {"X": x}
+        self.attrs = {"max_norm": 0.5}
+        self.outputs = {"Out": x * (0.5 / max(norm, 0.5))}
+        self.check_output()
+
+
+class TestSquaredL2Norm(OpTest):
+    op_type = "squared_l2_norm"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray((x ** 2).sum())}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestL1Norm(OpTest):
+    op_type = "l1_norm"
+
+    def test(self):
+        x = RS.uniform(0.2, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(np.abs(x).sum())}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSquaredL2Distance(OpTest):
+    op_type = "squared_l2_distance"
+
+    def test(self):
+        x = RS.rand(4, 3).astype("float32")
+        y = RS.rand(4, 3).astype("float32")
+        d = x - y
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (d ** 2).sum(axis=1, keepdims=True),
+                        "sub_result": d}
+        self.check_output(no_check_set=("sub_result",))
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestCosSim(OpTest):
+    op_type = "cos_sim"
+
+    def test(self):
+        x = RS.rand(4, 5).astype("float32") + 0.1
+        y = RS.rand(4, 5).astype("float32") + 0.1
+        num = (x * y).sum(axis=1)
+        xn = np.sqrt((x * x).sum(axis=1))
+        yn = np.sqrt((y * y).sum(axis=1))
+        out = (num / xn / yn).reshape(-1, 1)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out, "XNorm": xn.reshape(-1, 1),
+                        "YNorm": yn.reshape(-1, 1)}
+        self.check_output(no_check_set=("XNorm", "YNorm"))
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.05)
+
+
+class TestCompareOps(OpTest):
+    def test(self):
+        x = RS.randint(0, 3, (4, 4)).astype("float32")
+        y = RS.randint(0, 3, (4, 4)).astype("float32")
+        for op, fn in [("less_than", np.less), ("less_equal", np.less_equal),
+                       ("greater_than", np.greater),
+                       ("greater_equal", np.greater_equal),
+                       ("equal", np.equal), ("not_equal", np.not_equal)]:
+            self.op_type = op
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": fn(x, y)}
+            self.check_output()
+
+
+class TestLogicalOps(OpTest):
+    def test(self):
+        x = RS.rand(4, 4) > 0.5
+        y = RS.rand(4, 4) > 0.5
+        for op, fn in [("logical_and", np.logical_and),
+                       ("logical_or", np.logical_or),
+                       ("logical_xor", np.logical_xor)]:
+            self.op_type = op
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": fn(x, y)}
+            self.check_output()
+        self.op_type = "logical_not"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.logical_not(x)}
+        self.check_output()
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def test(self):
+        x = RS.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+        self.check_output()

@@ -1,0 +1,261 @@
+"""Op tests: optimizer update ops, dense + SelectedRows sparse paths
+(reference: test_sgd_op.py, test_momentum_op.py, test_adam_op.py,
+test_adamax_op.py, test_adagrad_op.py, test_decayed_adagrad_op.py,
+test_adadelta_op.py, test_rmsprop_op.py, test_ftrl_op.py,
+test_proximal_gd_op.py, test_proximal_adagrad_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.ragged import SelectedRows
+from op_test import OpTest
+
+RS = np.random.RandomState(5)
+
+
+def _pgl(shape=(4, 3)):
+    p = RS.rand(*shape).astype("float32")
+    g = RS.rand(*shape).astype("float32")
+    lr = np.asarray([0.1], dtype="float32")
+    return p, g, lr
+
+
+class TestSGD(OpTest):
+    op_type = "sgd"
+
+    def test(self):
+        p, g, lr = _pgl()
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+        self.check_output()
+
+
+class TestMomentum(OpTest):
+    op_type = "momentum"
+
+    def test(self):
+        p, g, lr = _pgl()
+        v = RS.rand(*p.shape).astype("float32")
+        mu = 0.9
+        v_out = mu * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": mu}
+        self.outputs = {"ParamOut": p - 0.1 * v_out, "VelocityOut": v_out}
+        self.check_output()
+
+
+class TestMomentumNesterov(OpTest):
+    op_type = "momentum"
+
+    def test(self):
+        p, g, lr = _pgl()
+        v = RS.rand(*p.shape).astype("float32")
+        mu = 0.9
+        v_out = mu * v + g
+        p_out = p - (g + mu * v_out) * 0.1
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": mu, "use_nesterov": True}
+        self.outputs = {"ParamOut": p_out, "VelocityOut": v_out}
+        self.check_output()
+
+
+class TestAdam(OpTest):
+    op_type = "adam"
+
+    def test(self):
+        p, g, lr = _pgl()
+        m1 = RS.rand(*p.shape).astype("float32")
+        m2 = RS.rand(*p.shape).astype("float32")
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.asarray([b1 ** 3], dtype="float32")
+        b2p = np.asarray([b2 ** 3], dtype="float32")
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+        p_out = p - lr_t * m1o / (np.sqrt(m2o) + eps)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "Moment1Out": m1o,
+                        "Moment2Out": m2o}
+        self.check_output()
+
+
+class TestAdamax(OpTest):
+    op_type = "adamax"
+
+    def test(self):
+        p, g, lr = _pgl()
+        m = RS.rand(*p.shape).astype("float32")
+        inf = RS.rand(*p.shape).astype("float32") + 0.1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.asarray([b1 ** 2], dtype="float32")
+        m_out = b1 * m + (1 - b1) * g
+        inf_out = np.maximum(b2 * inf, np.abs(g))
+        p_out = p - (0.1 / (1 - b1p)) * m_out / (inf_out + eps)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment": m, "InfNorm": inf, "Beta1Pow": b1p}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "MomentOut": m_out,
+                        "InfNormOut": inf_out}
+        self.check_output()
+
+
+class TestAdagrad(OpTest):
+    op_type = "adagrad"
+
+    def test(self):
+        p, g, lr = _pgl()
+        mom = RS.rand(*p.shape).astype("float32")
+        eps = 1e-6
+        mom_out = mom + g * g
+        p_out = p - 0.1 * g / (np.sqrt(mom_out) + eps)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment": mom}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "MomentOut": mom_out}
+        self.check_output()
+
+
+class TestDecayedAdagrad(OpTest):
+    op_type = "decayed_adagrad"
+
+    def test(self):
+        p, g, lr = _pgl()
+        mom = RS.rand(*p.shape).astype("float32")
+        decay, eps = 0.95, 1e-6
+        mom_out = decay * mom + (1 - decay) * g * g
+        p_out = p - 0.1 * g / (np.sqrt(mom_out) + eps)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment": mom}
+        self.attrs = {"decay": decay, "epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "MomentOut": mom_out}
+        self.check_output()
+
+
+class TestAdadelta(OpTest):
+    op_type = "adadelta"
+
+    def test(self):
+        p, g, _ = _pgl()
+        asg = RS.rand(*p.shape).astype("float32")
+        asu = RS.rand(*p.shape).astype("float32")
+        rho, eps = 0.95, 1e-6
+        asg_out = rho * asg + (1 - rho) * g * g
+        update = -np.sqrt((asu + eps) / (asg_out + eps)) * g
+        asu_out = rho * asu + (1 - rho) * update * update
+        self.inputs = {"Param": p, "Grad": g, "AvgSquaredGrad": asg,
+                       "AvgSquaredUpdate": asu}
+        self.attrs = {"rho": rho, "epsilon": eps}
+        self.outputs = {"ParamOut": p + update,
+                        "AvgSquaredGradOut": asg_out,
+                        "AvgSquaredUpdateOut": asu_out}
+        self.check_output()
+
+
+class TestRmsprop(OpTest):
+    op_type = "rmsprop"
+
+    def test(self):
+        p, g, lr = _pgl()
+        ms = RS.rand(*p.shape).astype("float32")
+        mom = RS.rand(*p.shape).astype("float32")
+        rho, eps, mu = 0.9, 1e-10, 0.9
+        ms_out = rho * ms + (1 - rho) * g * g
+        mom_out = mu * mom + 0.1 * g / np.sqrt(ms_out + eps)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "MeanSquare": ms, "Moment": mom}
+        self.attrs = {"decay": rho, "epsilon": eps, "momentum": mu}
+        self.outputs = {"ParamOut": p - mom_out, "MomentOut": mom_out,
+                        "MeanSquareOut": ms_out}
+        self.check_output()
+
+
+class TestFtrl(OpTest):
+    op_type = "ftrl"
+
+    def test(self):
+        p, g, lr = _pgl()
+        sq = RS.rand(*p.shape).astype("float32")
+        lin = RS.rand(*p.shape).astype("float32")
+        l1, l2, lrp = 0.1, 0.2, -0.5
+        new_sq = sq + g * g
+        sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / 0.1
+        lin_out = lin + g - sigma * p
+        denom = np.sqrt(new_sq) / 0.1 + 2 * l2
+        pre = (l1 * np.sign(lin_out) - lin_out) / denom
+        p_out = np.where(np.abs(lin_out) > l1, pre, 0.0)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "SquaredAccumulator": sq, "LinearAccumulator": lin}
+        self.attrs = {"l1": l1, "l2": l2, "lr_power": lrp}
+        self.outputs = {"ParamOut": p_out.astype("float32"),
+                        "SquaredAccumOut": new_sq,
+                        "LinearAccumOut": lin_out}
+        self.check_output(atol=1e-4)
+
+
+class TestProximalGD(OpTest):
+    op_type = "proximal_gd"
+
+    def test(self):
+        p, g, lr = _pgl()
+        l1, l2 = 0.1, 0.2
+        prox = p - 0.1 * g
+        p_out = np.sign(prox) / (1 + 0.1 * l2) * \
+            np.maximum(np.abs(prox) - 0.1 * l1, 0)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": p_out.astype("float32")}
+        self.check_output()
+
+
+class TestProximalAdagrad(OpTest):
+    op_type = "proximal_adagrad"
+
+    def test(self):
+        p, g, lr = _pgl()
+        mom = RS.rand(*p.shape).astype("float32")
+        l1, l2 = 0.1, 0.2
+        mom_out = mom + g * g
+        lr_t = 0.1 / np.sqrt(mom_out)
+        prox = p - lr_t * g
+        p_out = np.sign(prox) / (1 + lr_t * l2) * \
+            np.maximum(np.abs(prox) - lr_t * l1, 0)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment": mom}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": p_out.astype("float32"),
+                        "MomentOut": mom_out}
+        self.check_output()
+
+
+def test_sgd_selected_rows():
+    """Sparse SGD: only touched rows update (reference sgd_op.cc
+    SelectedRows path)."""
+    prog = __import__("paddle_tpu.fluid.framework",
+                      fromlist=["Program"]).Program()
+    block = prog.global_block()
+    p = RS.rand(6, 3).astype("float32")
+    rows = np.asarray([1, 4], dtype="int64")
+    gvals = RS.rand(2, 3).astype("float32")
+    grad = SelectedRows(rows, gvals, height=6)
+    lr = np.asarray([0.5], dtype="float32")
+
+    pv = block.create_var(name="P", shape=[6, 3], dtype="float32")
+    from paddle_tpu.core.types import VarType
+    gv = block.create_var(name="G", shape=[6, 3], dtype="float32",
+                          type=VarType.SELECTED_ROWS)
+    lv = block.create_var(name="LR", shape=[1], dtype="float32")
+    ov = block.create_var(name="PO", shape=[6, 3], dtype="float32")
+    block.append_op(type="sgd",
+                    inputs={"Param": pv, "Grad": gv, "LearningRate": lv},
+                    outputs={"ParamOut": ov})
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(prog, feed={"P": p, "G": grad, "LR": lr},
+                   fetch_list=["PO"], scope=fluid.Scope())
+    expect = p.copy()
+    expect[rows] -= 0.5 * gvals
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
