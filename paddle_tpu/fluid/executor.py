@@ -448,6 +448,11 @@ class Executor:
     def _prepare_feed(self, block_desc, name, val):
         if isinstance(val, (RaggedTensor, SelectedRows)):
             return val
+        if isinstance(val, (list, tuple)) and any(
+                isinstance(v, (RaggedTensor, SelectedRows))
+                for v in val):
+            # host array-of-tensors feed (e.g. beam_search_decode steps)
+            return list(val)
         vd = block_desc.vars.get(name)
         arr = np.asarray(val)
         if vd is not None and vd.dtype is not None:

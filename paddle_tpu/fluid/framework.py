@@ -417,6 +417,10 @@ def infer_shape_for_op(block, op_desc):
     if info.infer_shape is not None:
         info.infer_shape(block, op_desc)
         return
+    if not info.jittable:
+        # host kernels can't run under eval_shape; outputs keep their
+        # declared meta (reference: such ops hand-write InferShape)
+        return
     if op_registry.is_grad_op_type(op_desc.type):
         _grad_op_infer_shape(block, op_desc)
         return

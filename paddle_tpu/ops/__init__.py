@@ -23,3 +23,7 @@ from . import sparse        # noqa: F401
 from . import nn            # noqa: F401
 from . import sequence      # noqa: F401
 from . import control_flow  # noqa: F401
+from . import crf           # noqa: F401
+from . import ctc           # noqa: F401
+from . import beam          # noqa: F401
+from . import detection     # noqa: F401

@@ -1,0 +1,246 @@
+"""Detection ops (SSD family).
+
+TPU-native equivalents of the reference detection family
+(reference: paddle/operators/prior_box_op.cc, iou_similarity_op.cc,
+bipartite_match_op.cc, detection_output_op.cc).
+
+prior_box and iou_similarity are pure XLA (vectorized, no loops).
+bipartite_match and detection_output (NMS) are host ops: both are
+greedy sequential algorithms with data-dependent trip counts, and the
+reference runs bipartite_match CPU-only as well.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+@register_op("prior_box", stop_gradient_op=True,
+             nondiff_inputs=("Input", "Image"))
+def prior_box(ctx, ins, attrs):
+    """reference: prior_box_op.h — boxes [H, W, num_priors, 4] in
+    normalized (xmin, ymin, xmax, ymax)."""
+    feat = ins["Input"][0]
+    image = ins["Image"][0]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes") or []]
+    aspect_ratios = [float(a) for a in attrs.get("aspect_ratios") or [1.0]]
+    variances = [float(v) for v in
+                 attrs.get("variances") or [0.1, 0.1, 0.2, 0.2]]
+    flip = bool(attrs.get("flip", True))
+    clip = bool(attrs.get("clip", True))
+    offset = float(attrs.get("offset", 0.5))
+
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = float(attrs.get("step_w") or 0.0) or img_w / W
+    step_h = float(attrs.get("step_h") or 0.0) or img_h / H
+
+    # expanded aspect ratio list (reference: ExpandAspectRatios)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    # per-position (w, h) of each prior
+    pw, ph = [], []
+    for s, ms in enumerate(min_sizes):
+        pw.append(ms / 2.0)
+        ph.append(ms / 2.0)
+        if max_sizes:
+            big = np.sqrt(ms * max_sizes[s])
+            pw.append(big / 2.0)
+            ph.append(big / 2.0)
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            pw.append(ms * np.sqrt(ar) / 2.0)
+            ph.append(ms / np.sqrt(ar) / 2.0)
+    num_priors = len(pw)
+    pw = jnp.asarray(pw, jnp.float32)
+    ph = jnp.asarray(ph, jnp.float32)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cx = cx[None, :, None]  # [1, W, 1]
+    cy = cy[:, None, None]  # [H, 1, 1]
+    xmin = (cx - pw[None, None, :]) / img_w
+    xmax = (cx + pw[None, None, :]) / img_w
+    ymin = (cy - ph[None, None, :]) / img_h
+    ymax = (cy + ph[None, None, :]) / img_h
+    boxes = jnp.stack(
+        [jnp.broadcast_to(xmin, (H, W, num_priors)),
+         jnp.broadcast_to(ymin, (H, W, num_priors)),
+         jnp.broadcast_to(xmax, (H, W, num_priors)),
+         jnp.broadcast_to(ymax, (H, W, num_priors))], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, num_priors, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _iou(x, y):
+    """x: [N, 4], y: [M, 4] -> [N, M] IoU (xmin, ymin, xmax, ymax)."""
+    area_x = jnp.maximum(x[:, 2] - x[:, 0], 0) * \
+        jnp.maximum(x[:, 3] - x[:, 1], 0)
+    area_y = jnp.maximum(y[:, 2] - y[:, 0], 0) * \
+        jnp.maximum(y[:, 3] - y[:, 1], 0)
+    ix_min = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy_min = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix_max = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy_max = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(ix_max - ix_min, 0) * \
+        jnp.maximum(iy_max - iy_min, 0)
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", stop_gradient_op=True,
+             nondiff_inputs=("X", "Y"))
+def iou_similarity(ctx, ins, attrs):
+    """reference: iou_similarity_op.h — X may be a ragged [N, 4] per-image
+    box list; Y is [M, 4]."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    xv = x.values if isinstance(x, RaggedTensor) else x
+    out = _iou(xv, y)
+    if isinstance(x, RaggedTensor):
+        return {"Out": [x.with_values(out)]}
+    return {"Out": [out]}
+
+
+@register_op("bipartite_match", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("DistMat",))
+def bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching per image (reference:
+    bipartite_match_op.cc:44 BipartiteMatch).  DistMat may be ragged
+    (per-image row blocks)."""
+    dist_t = ins["DistMat"][0]
+    ragged = isinstance(dist_t, RaggedTensor)
+    if ragged:
+        splits = np.asarray(dist_t.last_splits())
+        dist = np.asarray(dist_t.values)
+    else:
+        dist = np.asarray(dist_t)
+        splits = np.asarray([0, dist.shape[0]], np.int64)
+    n_img = len(splits) - 1
+    col = dist.shape[1]
+    match_indices = np.full((n_img, col), -1, np.int32)
+    match_dist = np.zeros((n_img, col), np.float32)
+    for i in range(n_img):
+        sub = dist[int(splits[i]):int(splits[i + 1])]
+        row_pool = list(range(sub.shape[0]))
+        while row_pool:
+            best = (-1, -1, -1.0)
+            for j in range(col):
+                if match_indices[i, j] != -1:
+                    continue
+                for m in row_pool:
+                    d = sub[m, j]
+                    if d < 1e-6:
+                        continue
+                    if d > best[2]:
+                        best = (m, j, float(d))
+            if best[0] < 0:
+                break
+            m, j, d = best
+            match_indices[i, j] = m
+            match_dist[i, j] = d
+            row_pool.remove(m)
+    return {"ColToRowMatchIndices": [match_indices],
+            "ColToRowMatchDis": [match_dist]}
+
+
+def _nms(boxes, scores, nms_threshold, top_k):
+    """Greedy per-class NMS -> kept indices (reference:
+    detection_output_op.h ApplyNMSFast)."""
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(int(i))
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        ious = np.asarray(_iou(jnp.asarray(boxes[i][None]),
+                               jnp.asarray(boxes[rest])))[0]
+        order = rest[ious <= nms_threshold]
+    return keep
+
+
+@register_op("detection_output", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Loc", "Conf", "PriorBox"))
+def detection_output(ctx, ins, attrs):
+    """SSD detection output: decode loc predictions against priors,
+    per-class NMS, keep top_k (reference: detection_output_op.h).
+
+    Loc:  [N, num_priors * 4] location predictions.
+    Conf: [N, num_priors * num_classes] class scores (softmaxed here).
+    PriorBox: [num_priors * 2, 4] — boxes then variances (reference
+    stores priors and variances interleaved rows).
+    Out: [M, 7] rows (image_id, label, score, xmin, ymin, xmax, ymax);
+    M == 1 row of -1s when nothing passes (reference keeps shape [1, 7]).
+    """
+    loc = np.asarray(ins["Loc"][0])
+    conf = np.asarray(ins["Conf"][0])
+    prior = np.asarray(ins["PriorBox"][0]).reshape(-1, 4)
+    num_classes = int(attrs["num_classes"])
+    background = int(attrs.get("background_label_id", 0))
+    nms_threshold = float(attrs.get("nms_threshold", 0.45))
+    conf_threshold = float(attrs.get("confidence_threshold", 0.01))
+    top_k = int(attrs.get("top_k", 100))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+
+    n_prior = prior.shape[0] // 2
+    pboxes = prior[:n_prior]
+    pvars = prior[n_prior:]
+    N = loc.shape[0]
+    loc = loc.reshape(N, n_prior, 4)
+    conf = conf.reshape(N, n_prior, num_classes)
+    # softmax over classes
+    e = np.exp(conf - conf.max(axis=-1, keepdims=True))
+    conf = e / e.sum(axis=-1, keepdims=True)
+
+    # decode (reference: variance-encoded center-size decoding)
+    pw = pboxes[:, 2] - pboxes[:, 0]
+    ph = pboxes[:, 3] - pboxes[:, 1]
+    pcx = (pboxes[:, 0] + pboxes[:, 2]) / 2
+    pcy = (pboxes[:, 1] + pboxes[:, 3]) / 2
+    dcx = pvars[:, 0] * loc[:, :, 0] * pw + pcx
+    dcy = pvars[:, 1] * loc[:, :, 1] * ph + pcy
+    dw = np.exp(pvars[:, 2] * loc[:, :, 2]) * pw
+    dh = np.exp(pvars[:, 3] * loc[:, :, 3]) * ph
+    decoded = np.stack([dcx - dw / 2, dcy - dh / 2,
+                        dcx + dw / 2, dcy + dh / 2], axis=-1)
+
+    rows = []
+    for n in range(N):
+        all_dets = []
+        for c in range(num_classes):
+            if c == background:
+                continue
+            scores = conf[n, :, c]
+            mask = scores > conf_threshold
+            if not mask.any():
+                continue
+            idx = np.where(mask)[0]
+            keep = _nms(decoded[n, idx], scores[idx], nms_threshold,
+                        nms_top_k)
+            for k in keep:
+                i = idx[k]
+                all_dets.append((float(scores[i]), c, decoded[n, i]))
+        all_dets.sort(key=lambda d: -d[0])
+        for score, c, box in all_dets[:top_k]:
+            rows.append([float(n), float(c), score,
+                         float(box[0]), float(box[1]),
+                         float(box[2]), float(box[3])])
+    if not rows:
+        rows = [[-1.0] * 7]
+    return {"Out": [np.asarray(rows, np.float32)]}

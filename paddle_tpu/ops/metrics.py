@@ -87,3 +87,59 @@ def precision_recall(ctx, ins, attrs):
     return {"BatchMetrics": [jnp.concatenate([macro, micro])],
             "AccumMetrics": [jnp.concatenate([macro, micro])],
             "AccumStatesInfo": [states]}
+
+
+@register_op("positive_negative_pair", stop_gradient_op=True,
+             jittable=False,
+             nondiff_inputs=("Score", "Label", "QueryID", "Weight",
+                             "AccumulatePositivePair",
+                             "AccumulateNegativePair",
+                             "AccumulateNeutralPair"))
+def positive_negative_pair(ctx, ins, attrs):
+    """Per-query ranking pair statistics (reference:
+    positive_negative_pair_op.h PositiveNegativePairKernel)."""
+    import numpy as np
+
+    score = np.asarray(_vals(ins["Score"][0]))
+    label = np.asarray(_vals(ins["Label"][0])).reshape(-1)
+    query = np.asarray(_vals(ins["QueryID"][0])).reshape(-1)
+    weight = None
+    if ins.get("Weight") and ins["Weight"][0] is not None:
+        weight = np.asarray(_vals(ins["Weight"][0])).reshape(-1)
+    column = int(attrs.get("column", 0))
+    if column < 0:
+        column += score.shape[1]
+    s = score[:, column]
+
+    pos = neg = neu = 0.0
+    for q in np.unique(query):
+        idx = np.where(query == q)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                w = ((weight[i] + weight[j]) / 2.0
+                     if weight is not None else 1.0)
+                if label[i] == label[j]:
+                    continue
+                same = (s[i] == s[j])
+                correct = (s[i] > s[j]) == (label[i] > label[j])
+                if same:
+                    neu += w
+                elif correct:
+                    pos += w
+                else:
+                    neg += w
+
+    def _acc(slot):
+        v = ins.get(slot)
+        if v and v[0] is not None:
+            return float(np.asarray(v[0]).reshape(-1)[0])
+        return 0.0
+
+    pos += _acc("AccumulatePositivePair")
+    neg += _acc("AccumulateNegativePair")
+    neu += _acc("AccumulateNeutralPair")
+    f32 = np.float32
+    return {"PositivePair": [np.asarray([pos], f32)],
+            "NegativePair": [np.asarray([neg], f32)],
+            "NeutralPair": [np.asarray([neu], f32)]}

@@ -44,6 +44,7 @@ def ragged_to_padded(rt, fill=0.0):
     seg, inseq, valid = _seg_pos(rt)
     B = rt.nseq()
     T = rt.values.shape[0]
+    fill = jnp.asarray(fill).astype(rt.values.dtype)
     padded = jnp.full((B, T) + rt.values.shape[1:], fill, rt.values.dtype)
     seg_s = jnp.where(valid, seg, B - 1)
     in_s = jnp.where(valid, inseq, T - 1)

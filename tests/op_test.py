@@ -236,7 +236,7 @@ class OpTest:
             ragged = isinstance(base, RaggedTensor)
             base_vals = np.asarray(base.values if ragged else base,
                                    np.float64)
-            delta = numeric_delta or (1e-3 if base_vals.dtype else 1e-3)
+            delta = 1e-3 if numeric_delta is None else numeric_delta
             numeric = np.zeros_like(base_vals)
             flat = base_vals.reshape(-1)
             num_flat = numeric.reshape(-1)

@@ -1,0 +1,234 @@
+"""Op tests: beam_search, beam_search_decode, prior_box, iou_similarity,
+bipartite_match, detection_output, positive_negative_pair (reference:
+beam_search_op_test.cc, beam_search_decode_op_test.cc,
+test_prior_box_op.py, test_iou_similarity_op.py (later era),
+test_bipartite_match_op.py, test_detection_output_op.py (v2 era),
+test_positive_negative_pair_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.core.ragged import RaggedTensor
+from op_test import OpTest
+
+RS = np.random.RandomState(77)
+
+
+def _run_op(op_type, inputs, outputs_spec, attrs):
+    """inputs: name -> (value, lod_level); outputs_spec: slot ->
+    [(name, dtype)]"""
+    prog = framework.Program()
+    block = prog.global_block()
+    ins = {}
+    feeds = {}
+    for slot, entries in inputs.items():
+        vs = []
+        for name, val, lod in entries:
+            arr = val.values if isinstance(val, RaggedTensor) else val
+            v = block.create_var(name=name,
+                                 shape=list(np.asarray(arr).shape),
+                                 dtype=str(np.asarray(arr).dtype),
+                                 lod_level=lod)
+            feeds[name] = val
+            vs.append(v)
+        ins[slot] = vs
+    outs = {}
+    fetch = []
+    for slot, entries in outputs_spec.items():
+        vs = []
+        for name, dtype in entries:
+            v = block.create_var(name=name, shape=[1], dtype=dtype)
+            vs.append(v)
+            fetch.append(name)
+        outs[slot] = vs
+    block.append_op(type=op_type, inputs=ins, outputs=outs, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(prog, feed=feeds, fetch_list=fetch,
+                   scope=fluid.Scope(), return_numpy=False)
+
+
+def test_beam_search():
+    """Mirrors reference beam_search_op_test.cc: 2 sources x 2 beams,
+    4 candidates each, beam_size 2, end_id 0."""
+    # pre_ids: [4, 1]; beam row 2's prefix hit end_id
+    pre_ids = RaggedTensor(
+        np.asarray([[1], [2], [0], [4]], np.int64),
+        [np.asarray([0, 2, 4]), np.asarray([0, 1, 2, 3, 4])])
+    ids = RaggedTensor(
+        np.asarray([[4, 2, 5], [2, 1, 3], [3, 5, 2], [8, 2, 1]],
+                   np.int64),
+        [np.asarray([0, 2, 4]), np.asarray([0, 1, 2, 3, 4])])
+    scores = RaggedTensor(
+        np.asarray([[0.5, 0.3, 0.2], [0.6, 0.3, 0.1],
+                    [0.9, 0.5, 0.1], [0.7, 0.5, 0.1]], np.float32),
+        ids.row_splits)
+
+    sel_ids, sel_scores = _run_op(
+        "beam_search",
+        {"pre_ids": [("pre", pre_ids, 2)],
+         "ids": [("ids", ids, 2)],
+         "scores": [("sc", scores, 2)]},
+        {"selected_ids": [("sid", "int64")],
+         "selected_scores": [("ssc", "float32")]},
+        {"level": 0, "beam_size": 2, "end_id": 0})
+
+    # source 0: top2 of {.5,.3,.2,.6,.3,.1} -> (row1 id2 .6), (row0 id4 .5)
+    # source 1: top2 -> (row2 id3 .9), (row3 id8 .7); row2 prefix==end ->
+    # pruned -> only row3 survives
+    np.testing.assert_array_equal(
+        np.asarray(sel_ids.values).ravel(), [4, 2, 8])
+    np.testing.assert_allclose(
+        np.asarray(sel_scores.values).ravel(), [0.5, 0.6, 0.7], rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(sel_ids.row_splits[0]),
+                                  [0, 2, 4])
+    np.testing.assert_array_equal(np.asarray(sel_ids.row_splits[1]),
+                                  [0, 1, 2, 2, 3])
+
+
+def test_beam_search_decode():
+    """Two-step decode, one source, beam 2: backtrack chains."""
+    # step0: 2 items (roots), rows [0,1] of one source
+    step0 = RaggedTensor(
+        np.asarray([[1], [2]], np.int64),
+        [np.asarray([0, 2]), np.asarray([0, 1, 2])])
+    s_step0 = RaggedTensor(
+        np.asarray([[0.1], [0.2]], np.float32), step0.row_splits)
+    # step1: item0 of step0 -> tokens 3,4 ; item1 -> token 5
+    step1 = RaggedTensor(
+        np.asarray([[3], [4], [5]], np.int64),
+        [np.asarray([0, 2]), np.asarray([0, 2, 3])])
+    s_step1 = RaggedTensor(
+        np.asarray([[0.3], [0.4], [0.5]], np.float32), step1.row_splits)
+
+    prog = framework.Program()
+    block = prog.global_block()
+    ids_v = block.create_var(name="ids_arr", shape=[1], dtype="int64")
+    sc_v = block.create_var(name="sc_arr", shape=[1], dtype="float32")
+    out_i = block.create_var(name="sent_ids", shape=[1], dtype="int64")
+    out_s = block.create_var(name="sent_scores", shape=[1],
+                             dtype="float32")
+    block.append_op(type="beam_search_decode",
+                    inputs={"Ids": [ids_v], "Scores": [sc_v]},
+                    outputs={"SentenceIds": [out_i],
+                             "SentenceScores": [out_s]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    sent_ids, sent_scores = exe.run(
+        prog,
+        feed={"ids_arr": [step0, step1], "sc_arr": [s_step0, s_step1]},
+        fetch_list=["sent_ids", "sent_scores"], scope=fluid.Scope(),
+        return_numpy=False)
+
+    # three hypotheses: [1,3], [1,4], [2,5]
+    np.testing.assert_array_equal(
+        np.asarray(sent_ids.values).ravel(), [1, 3, 1, 4, 2, 5])
+    np.testing.assert_array_equal(np.asarray(sent_ids.row_splits[0]),
+                                  [0, 3])
+    np.testing.assert_array_equal(np.asarray(sent_ids.row_splits[1]),
+                                  [0, 2, 4, 6])
+
+
+class TestPriorBox(OpTest):
+    op_type = "prior_box"
+
+    def test(self):
+        feat = RS.rand(1, 8, 2, 2).astype("float32")
+        image = RS.rand(1, 3, 16, 16).astype("float32")
+        min_sizes, ar = [4.0], [2.0]
+        self.inputs = {"Input": feat, "Image": image}
+        self.attrs = {"min_sizes": min_sizes, "max_sizes": [],
+                      "aspect_ratios": ar, "flip": True, "clip": True,
+                      "variances": [0.1, 0.1, 0.2, 0.2]}
+        # num_priors = 1 (min) + 2 (ar 2.0 + flip)
+        H = W = 2
+        num_priors = 3
+        step = 16 / 2
+        boxes = np.zeros((H, W, num_priors, 4), "float32")
+        whs = [(2.0, 2.0),
+               (4.0 * np.sqrt(2.0) / 2, 4.0 / np.sqrt(2.0) / 2),
+               (4.0 * np.sqrt(0.5) / 2, 4.0 / np.sqrt(0.5) / 2)]
+        for i in range(H):
+            for j in range(W):
+                cx, cy = (j + 0.5) * step, (i + 0.5) * step
+                for k, (pw, ph) in enumerate(whs):
+                    boxes[i, j, k] = [
+                        max((cx - pw) / 16, 0), max((cy - ph) / 16, 0),
+                        min((cx + pw) / 16, 1), min((cy + ph) / 16, 1)]
+        var = np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], "float32"),
+                      (H, W, num_priors, 1))
+        self.outputs = {"Boxes": boxes, "Variances": var}
+        self.check_output(atol=1e-5)
+
+
+class TestIouSimilarity(OpTest):
+    op_type = "iou_similarity"
+
+    def test(self):
+        x = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], "float32")
+        y = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4]], "float32")
+        out = np.asarray([[1.0, 0.0], [1.0 / 7, 1.0 / 7]], "float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5)
+
+
+class TestBipartiteMatch(OpTest):
+    op_type = "bipartite_match"
+
+    def test(self):
+        dist = np.asarray([[0.1, 0.9, 0.3],
+                           [0.8, 0.2, 0.7]], "float32")
+        self.inputs = {"DistMat": dist}
+        # greedy: best overall is (0,1,.9) -> col1=row0; next best among
+        # remaining rows/cols: (1,0,.8) -> col0=row1; rows exhausted
+        self.outputs = {
+            "ColToRowMatchIndices": np.asarray([[1, 0, -1]], "int32"),
+            "ColToRowMatchDis": np.asarray([[0.8, 0.9, 0.0]], "float32")}
+        self.check_output()
+
+
+def test_detection_output():
+    n_prior, num_classes = 2, 3
+    loc = np.zeros((1, n_prior * 4), "float32")  # no offset: keep priors
+    conf = np.zeros((1, n_prior * num_classes), "float32")
+    conf[0, 0 * num_classes + 1] = 4.0   # prior 0 -> class 1 confident
+    conf[0, 1 * num_classes + 2] = 4.0   # prior 1 -> class 2 confident
+    priors = np.asarray([[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9],
+                         [0.1, 0.1, 0.2, 0.2],
+                         [0.1, 0.1, 0.2, 0.2]], "float32")
+    out, = _run_op(
+        "detection_output",
+        {"Loc": [("loc", loc, 0)], "Conf": [("conf", conf, 0)],
+         "PriorBox": [("prior", priors, 0)]},
+        {"Out": [("out", "float32")]},
+        {"num_classes": num_classes, "background_label_id": 0,
+         "nms_threshold": 0.45, "confidence_threshold": 0.3,
+         "top_k": 10, "nms_top_k": 10})
+    out = np.asarray(out)
+    assert out.shape == (2, 7)
+    # both detections kept, sorted by score; boxes equal the priors
+    labels = sorted(out[:, 1].tolist())
+    assert labels == [1.0, 2.0]
+    for row in out:
+        prior_idx = 0 if row[1] == 1.0 else 1
+        np.testing.assert_allclose(row[3:], priors[prior_idx], atol=1e-5)
+
+
+def test_positive_negative_pair():
+    score = np.asarray([[0.8], [0.2], [0.5], [0.6]], "float32")
+    label = np.asarray([[1.0], [0.0], [1.0], [0.0]], "float32")
+    query = np.asarray([[1], [1], [2], [2]], "int64")
+    pos, neg, neu = _run_op(
+        "positive_negative_pair",
+        {"Score": [("s", score, 0)], "Label": [("l", label, 0)],
+         "QueryID": [("q", query, 0)]},
+        {"PositivePair": [("pp", "float32")],
+         "NegativePair": [("np_", "float32")],
+         "NeutralPair": [("nu", "float32")]},
+        {"column": 0})
+    # q1: (0.8,1) vs (0.2,0) correct -> pos; q2: (0.5,1) vs (0.6,0)
+    # wrong -> neg
+    assert float(np.asarray(pos)[0]) == 1.0
+    assert float(np.asarray(neg)[0]) == 1.0
+    assert float(np.asarray(neu)[0]) == 0.0

@@ -1,0 +1,222 @@
+"""Op tests: linear_chain_crf, crf_decoding, chunk_eval, warpctc,
+ctc_align, edit_distance, sequence_erase (reference:
+test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_chunk_eval_op.py, test_warpctc_op.py, test_ctc_align_op.py,
+test_edit_distance_op.py, test_sequence_erase_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+RS = np.random.RandomState(99)
+
+
+def _crf_ref(emissions, transition, labels):
+    """Brute-force NLL over all tag paths for one sequence."""
+    import itertools
+    a, b, w = transition[0], transition[1], transition[2:]
+    T, D = emissions.shape
+
+    def score(path):
+        s = a[path[0]] + b[path[-1]] + emissions[np.arange(T), path].sum()
+        for t in range(1, T):
+            s += w[path[t - 1], path[t]]
+        return s
+
+    z = np.logaddexp.reduce([score(p) for p in
+                             itertools.product(range(D), repeat=T)])
+    return z - score(labels)
+
+
+class TestLinearChainCRF(OpTest):
+    op_type = "linear_chain_crf"
+
+    def test(self):
+        D = 3
+        lod = [[0, 2, 5, 6]]
+        T = lod[0][-1]
+        emission = RS.uniform(-1, 1, (T, D)).astype("float32")
+        transition = RS.uniform(-0.5, 0.5, (D + 2, D)).astype("float32")
+        label = RS.randint(0, D, (T, 1)).astype("int64")
+
+        nll = []
+        for s in range(len(lod[0]) - 1):
+            lo, hi = lod[0][s], lod[0][s + 1]
+            nll.append(_crf_ref(
+                emission[lo:hi].astype("float64"),
+                transition.astype("float64"),
+                label[lo:hi, 0]))
+        self.inputs = {"Emission": (emission, lod),
+                       "Transition": transition,
+                       "Label": (label, lod)}
+        self.outputs = {
+            "LogLikelihood": np.asarray(nll, "float32").reshape(-1, 1)}
+        self.check_output(
+            atol=1e-4,
+            no_check_set=("Alpha", "EmissionExps", "TransitionExps"))
+        self.check_grad(["Emission", "Transition"], "LogLikelihood",
+                        max_relative_error=0.05, no_grad_set={"Label"})
+
+
+class TestCRFDecoding(OpTest):
+    op_type = "crf_decoding"
+
+    def test(self):
+        import itertools
+        D = 3
+        lod = [[0, 3, 5]]
+        T = lod[0][-1]
+        emission = RS.uniform(-1, 1, (T, D)).astype("float32")
+        transition = RS.uniform(-0.5, 0.5, (D + 2, D)).astype("float32")
+
+        a, b, w = transition[0], transition[1], transition[2:]
+        best = np.zeros((T, 1), "int32")
+        for s in range(len(lod[0]) - 1):
+            lo, hi = lod[0][s], lod[0][s + 1]
+            L = hi - lo
+            paths = list(itertools.product(range(D), repeat=L))
+
+            def score(p):
+                sc = a[p[0]] + b[p[-1]] + \
+                    emission[lo:hi][np.arange(L), p].sum()
+                for t in range(1, L):
+                    sc += w[p[t - 1], p[t]]
+                return sc
+
+            best[lo:hi, 0] = paths[int(np.argmax([score(p)
+                                                  for p in paths]))]
+        self.inputs = {"Emission": (emission, lod),
+                       "Transition": transition}
+        self.outputs = {"ViterbiPath": (best, lod)}
+        self.check_output()
+
+
+class TestChunkEvalIOB(OpTest):
+    op_type = "chunk_eval"
+
+    def test(self):
+        # tags: IOB, 2 types: 0=B-0, 1=I-0, 2=B-1, 3=I-1, 4=O
+        lod = [[0, 6]]
+        # label:  B-0 I-0 O  B-1 I-1 O  -> chunks (0,1,t0), (3,4,t1)
+        label = np.asarray([0, 1, 4, 2, 3, 4]).reshape(-1, 1) \
+            .astype("int64")
+        # infer:  B-0 I-0 O  B-1 O   O  -> chunks (0,1,t0), (3,3,t1)
+        infer = np.asarray([0, 1, 4, 2, 4, 4]).reshape(-1, 1) \
+            .astype("int64")
+        self.inputs = {"Inference": (infer, lod), "Label": (label, lod)}
+        self.attrs = {"num_chunk_types": 2, "chunk_scheme": "IOB"}
+        self.outputs = {
+            "Precision": np.asarray([0.5], "float32"),
+            "Recall": np.asarray([0.5], "float32"),
+            "F1-Score": np.asarray([0.5], "float32"),
+            "NumInferChunks": np.asarray([2], "int32"),
+            "NumLabelChunks": np.asarray([2], "int32"),
+            "NumCorrectChunks": np.asarray([1], "int32")}
+        self.check_output()
+
+
+def _ctc_ref(logp, labels, blank):
+    """Brute-force CTC -log p(labels | logits) for one sequence."""
+    import itertools
+    T, C = logp.shape
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse
+        out = []
+        prev = None
+        for t in path:
+            if t != prev:
+                if t != blank:
+                    out.append(t)
+            prev = t
+        if out == list(labels):
+            total = np.logaddexp(total,
+                                 sum(logp[t, path[t]] for t in range(T)))
+    return -total
+
+
+class TestWarpCTC(OpTest):
+    op_type = "warpctc"
+
+    def test(self):
+        C = 4  # classes incl. blank 0
+        logits_lod = [[0, 4, 7]]
+        label_lod = [[0, 2, 3]]
+        T = logits_lod[0][-1]
+        logits = RS.uniform(-1, 1, (T, C)).astype("float32")
+        labels = np.asarray([[1], [2], [3]], dtype="int64")
+
+        losses = []
+        for s in range(2):
+            lo, hi = logits_lod[0][s], logits_lod[0][s + 1]
+            llo, lhi = label_lod[0][s], label_lod[0][s + 1]
+            lg = logits[lo:hi].astype("float64")
+            lp = lg - np.log(np.exp(lg).sum(axis=1, keepdims=True))
+            losses.append(_ctc_ref(lp, labels[llo:lhi, 0].tolist(), 0))
+        self.inputs = {"Logits": (logits, logits_lod),
+                       "Label": (labels, label_lod)}
+        self.attrs = {"blank": 0, "norm_by_times": False}
+        self.outputs = {
+            "Loss": np.asarray(losses, "float32").reshape(-1, 1)}
+        self.check_output(atol=1e-4, no_check_set=("WarpCTCGrad",))
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.05,
+                        no_grad_set={"Label"})
+
+
+class TestCTCAlign(OpTest):
+    op_type = "ctc_align"
+
+    def test(self):
+        lod = [[0, 6, 10]]
+        x = np.asarray([0, 1, 1, 0, 2, 2, 0, 3, 0, 3]).reshape(-1, 1) \
+            .astype("int32")
+        self.inputs = {"Input": (x, lod)}
+        self.attrs = {"blank": 0, "merge_repeated": True}
+        out = np.asarray([1, 2, 3, 3]).reshape(-1, 1).astype("int32")
+        self.outputs = {"Output": (out, [[0, 2, 4]])}
+        self.check_output()
+
+
+class TestEditDistance(OpTest):
+    op_type = "edit_distance"
+
+    def test(self):
+        hyp_lod = [[0, 3, 7]]
+        ref_lod = [[0, 4, 8]]
+        # "kitten" style: hyp [1,2,3] vs ref [1,3,3,4] -> distance 2
+        hyps = np.asarray([1, 2, 3, 5, 6, 7, 8]).reshape(-1, 1) \
+            .astype("int64")
+        refs = np.asarray([1, 3, 3, 4, 5, 6, 9, 8]).reshape(-1, 1) \
+            .astype("int64")
+        self.inputs = {"Hyps": (hyps, hyp_lod), "Refs": (refs, ref_lod)}
+        self.outputs = {"Out": np.asarray([[2.0], [1.0]], "float32"),
+                        "SequenceNum": np.asarray([2], "int32")}
+        self.check_output()
+
+
+class TestEditDistanceNormalized(OpTest):
+    op_type = "edit_distance"
+
+    def test(self):
+        hyps = np.asarray([1, 2, 3]).reshape(-1, 1).astype("int64")
+        refs = np.asarray([1, 3, 3, 4]).reshape(-1, 1).astype("int64")
+        self.inputs = {"Hyps": (hyps, [[0, 3]]),
+                       "Refs": (refs, [[0, 4]])}
+        self.attrs = {"normalized": True}
+        self.outputs = {"Out": np.asarray([[0.5]], "float32"),
+                        "SequenceNum": np.asarray([1], "int32")}
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    op_type = "sequence_erase"
+
+    def test(self):
+        lod = [[0, 4, 7]]
+        x = np.asarray([1, 0, 2, 0, 0, 3, 4]).reshape(-1, 1) \
+            .astype("int32")
+        self.inputs = {"X": (x, lod)}
+        self.attrs = {"tokens": [0]}
+        out = np.asarray([1, 2, 3, 4]).reshape(-1, 1).astype("int32")
+        self.outputs = {"Out": (out, [[0, 2, 4]])}
+        self.check_output()
