@@ -10,11 +10,11 @@ from .image import (lenet5, mlp, smallnet_mnist_cifar, alexnet, vgg,
                     vgg16, vgg19, resnet, resnet50, resnet101,
                     resnet_cifar10, googlenet)
 from .text import (stacked_lstm_text_classifier, conv_text_classifier,
-                   word2vec_ngram)
+                   word2vec_ngram, seq2seq)
 
 __all__ = [
     "lenet5", "mlp", "smallnet_mnist_cifar", "alexnet", "vgg", "vgg16",
     "vgg19", "resnet", "resnet50", "resnet101", "resnet_cifar10",
     "googlenet", "stacked_lstm_text_classifier", "conv_text_classifier",
-    "word2vec_ngram",
+    "word2vec_ngram", "seq2seq",
 ]

@@ -51,6 +51,38 @@ def conv_text_classifier(data, dict_dim, class_dim=2, emb_dim=128,
     return layers.fc(input=[conv_3, conv_4], size=class_dim, act="softmax")
 
 
+def seq2seq(src, trg_in, src_dict_size, trg_dict_size, emb_dim=32,
+            hidden_dim=32, encoder_depth=1):
+    """Encoder-decoder translation model, teacher-forced training path
+    (reference: tests/book/test_machine_translation.py — GRU/LSTM
+    encoder, DynamicRNN decoder seeded from the encoder's last state).
+
+    Returns per-step softmax over the target dictionary (ragged, aligned
+    with ``trg_in``).
+    """
+    src_emb = layers.embedding(input=src, size=[src_dict_size, emb_dim])
+    enc_proj = layers.fc(input=src_emb, size=hidden_dim * 4)
+    enc_hidden, _ = layers.dynamic_lstm(input=enc_proj,
+                                        size=hidden_dim * 4)
+    for _ in range(1, encoder_depth):
+        enc_proj = layers.fc(input=enc_hidden, size=hidden_dim * 4)
+        enc_hidden, _ = layers.dynamic_lstm(input=enc_proj,
+                                            size=hidden_dim * 4)
+    enc_last = layers.sequence_last_step(input=enc_hidden)  # [B, hid]
+
+    trg_emb = layers.embedding(input=trg_in, size=[trg_dict_size, emb_dim])
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        cur = rnn.step_input(trg_emb)
+        mem = rnn.memory(init=enc_last)
+        out = layers.fc(input=[cur, mem], size=hidden_dim, act="tanh")
+        prob = layers.fc(input=out, size=trg_dict_size, act="softmax")
+        rnn.update_memory(mem, out)
+        rnn.step_output(prob)
+    return rnn.outputs[0]
+
+
 def word2vec_ngram(words, dict_size, emb_dim=32, hidden_size=256,
                    shared_embedding=True):
     """N-gram neural language model (reference:

@@ -1,0 +1,54 @@
+"""Book test: CIFAR-10 image classification (VGG + ResNet).
+
+Parity target: reference tests/book/test_image_classification_train.py
+— vgg16_bn_drop and resnet_cifar10 on CIFAR, a few real training
+iterations, loss must improve.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import resnet_cifar10, vgg
+
+
+def _train(model_fn, batch_size=16, iters=10, lr=0.01):
+    image = fluid.layers.data(name="pixel", shape=[3, 32, 32],
+                              dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    logits = model_fn(image)  # model heads emit logits
+    cost = fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                   label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+
+    reader = paddle.batch(paddle.dataset.cifar.train10(),
+                          batch_size=batch_size)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(feed_list=[image, label], place=place)
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for batch in reader():
+        if len(batch) != batch_size:
+            continue
+        out, = exe.run(fluid.default_main_program(),
+                       feed=feeder.feed(batch),
+                       fetch_list=[avg_cost])
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+        if len(losses) >= iters:
+            break
+    assert np.isfinite(losses[-1]), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+    return losses
+
+
+def test_image_classification_resnet():
+    _train(lambda im: resnet_cifar10(im, class_dim=10, depth=20))
+
+
+def test_image_classification_vgg():
+    _train(lambda im: vgg(im, class_dim=10, depth=16, with_bn=True,
+                          drop_rate=0.0))

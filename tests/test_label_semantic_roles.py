@@ -1,0 +1,86 @@
+"""Book test: semantic role labeling with a linear-chain CRF.
+
+Parity target: reference tests/book/test_label_semantic_roles.py —
+8 feature sequences embedded, stacked bidirectional LSTM, per-step
+emission fc, linear_chain_crf loss + crf_decoding.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+WORD_DICT, VERB_DICT, LABEL_DICT = paddle.dataset.conll05.get_dict()
+MARK_DICT_LEN = 2
+EMB = 16
+HID = 32
+
+
+def _db_lstm(word, predicate, mark):
+    word_emb = layers.embedding(input=word, size=[len(WORD_DICT), EMB])
+    pred_emb = layers.embedding(input=predicate,
+                                size=[len(VERB_DICT), EMB])
+    mark_emb = layers.embedding(input=mark, size=[MARK_DICT_LEN, EMB])
+
+    hidden0 = layers.fc(input=[word_emb, pred_emb, mark_emb],
+                        size=HID * 4, act="tanh")
+    lstm0, _ = layers.dynamic_lstm(input=hidden0, size=HID * 4)
+    fc1 = layers.fc(input=[hidden0, lstm0], size=HID * 4, act="tanh")
+    lstm1, _ = layers.dynamic_lstm(input=fc1, size=HID * 4,
+                                   is_reverse=True)
+    return layers.fc(input=[fc1, lstm1], size=len(LABEL_DICT), act=None)
+
+
+def test_label_semantic_roles():
+    word = layers.data(name="word_data", shape=[1], dtype="int64",
+                       lod_level=1)
+    predicate = layers.data(name="verb_data", shape=[1], dtype="int64",
+                            lod_level=1)
+    mark = layers.data(name="mark_data", shape=[1], dtype="int64",
+                       lod_level=1)
+    target = layers.data(name="target", shape=[1], dtype="int64",
+                         lod_level=1)
+
+    feature_out = _db_lstm(word, predicate, mark)
+    crf_cost = layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name="crfw"))
+    avg_cost = layers.mean(x=crf_cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    # decoding path shares the transition parameter
+    crf_decode = layers.crf_decoding(input=feature_out,
+                                     param_attr=fluid.ParamAttr(name="crfw"))
+
+    reader = paddle.batch(paddle.dataset.conll05.test(), batch_size=8)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+
+    def pick(sample):
+        # dataset yields (word, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark,
+        # label); the slim model uses word/pred/mark/label
+        return sample[0], sample[6], sample[7], sample[8]
+
+    feeder = fluid.DataFeeder(
+        feed_list=[word, predicate, mark, target], place=place)
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for pass_id in range(2):
+        for batch in reader():
+            batch = [pick(s) for s in batch]
+            if len(batch) != 8:
+                continue
+            out, path = exe.run(fluid.default_main_program(),
+                                feed=feeder.feed(batch),
+                                fetch_list=[avg_cost, crf_decode])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert np.isfinite(losses[-1])
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]), (
+        losses[:4], losses[-4:])
+    # viterbi path produces valid label ids (fetch is ragged: one label
+    # per timestep)
+    path = np.asarray(getattr(path, "values", path))
+    assert path.min() >= 0 and path.max() < len(LABEL_DICT)

@@ -1,0 +1,51 @@
+"""Book test: neural machine translation (seq2seq), teacher-forced.
+
+Parity target: reference tests/book/test_machine_translation.py — WMT14
+reader feeding (src, trg_in, trg_next) ragged id sequences; encoder LSTM
++ DynamicRNN decoder; cross-entropy on next-token; loss decreases.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import seq2seq
+
+DICT_SIZE = 1000
+
+
+def test_machine_translation():
+    src = fluid.layers.data(name="src_word_id", shape=[1], dtype="int64",
+                            lod_level=1)
+    trg_in = fluid.layers.data(name="target_language_word", shape=[1],
+                               dtype="int64", lod_level=1)
+    trg_next = fluid.layers.data(name="target_language_next_word",
+                                 shape=[1], dtype="int64", lod_level=1)
+
+    prob = seq2seq(src, trg_in, DICT_SIZE, DICT_SIZE,
+                   emb_dim=32, hidden_dim=32)
+    cost = fluid.layers.cross_entropy(input=prob, label=trg_next)
+    avg_cost = fluid.layers.mean(x=cost)
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+
+    reader = paddle.batch(paddle.dataset.wmt14.train(DICT_SIZE),
+                          batch_size=8)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(feed_list=[src, trg_in, trg_next],
+                              place=place)
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for batch in reader():
+        if len(batch) != 8:
+            continue
+        out, = exe.run(fluid.default_main_program(),
+                       feed=feeder.feed(batch),
+                       fetch_list=[avg_cost])
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+        if len(losses) >= 60:
+            break
+    assert np.isfinite(losses[-1])
+    assert np.mean(losses[-6:]) < np.mean(losses[:6]), (
+        losses[:6], losses[-6:])

@@ -1,0 +1,99 @@
+"""Book test: MovieLens-style recommender.
+
+Parity target: reference tests/book/test_recommender_system.py — user
+tower (id/gender/age/job embeddings -> fc), movie tower (id embedding +
+category/title sequence pools -> fc), cosine similarity scaled to
+ratings, square error loss.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _usr_combined():
+    uid = layers.data(name="user_id", shape=[1], dtype="int64")
+    usr_emb = layers.embedding(
+        input=uid, size=[paddle.dataset.movielens.max_user_id() + 1, 32],
+        param_attr="user_table")
+    usr_fc = layers.fc(input=usr_emb, size=32)
+
+    gender = layers.data(name="gender_id", shape=[1], dtype="int64")
+    gender_fc = layers.fc(
+        input=layers.embedding(input=gender, size=[2, 16],
+                               param_attr="gender_table"), size=16)
+
+    age = layers.data(name="age_id", shape=[1], dtype="int64")
+    age_fc = layers.fc(
+        input=layers.embedding(
+            input=age, size=[len(paddle.dataset.movielens.age_table), 16],
+            param_attr="age_table"), size=16)
+
+    job = layers.data(name="job_id", shape=[1], dtype="int64")
+    job_fc = layers.fc(
+        input=layers.embedding(
+            input=job, size=[paddle.dataset.movielens.max_job_id() + 1, 16],
+            param_attr="job_table"), size=16)
+
+    return layers.fc(input=[usr_fc, gender_fc, age_fc, job_fc],
+                     size=200, act="tanh"), [uid, gender, age, job]
+
+
+def _mov_combined():
+    mid = layers.data(name="movie_id", shape=[1], dtype="int64")
+    mov_emb = layers.embedding(
+        input=mid, size=[paddle.dataset.movielens.max_movie_id() + 1, 32],
+        param_attr="movie_table")
+    mov_fc = layers.fc(input=mov_emb, size=32)
+
+    cat = layers.data(name="category_id", shape=[1], dtype="int64",
+                      lod_level=1)
+    cat_emb = layers.embedding(
+        input=cat, size=[len(paddle.dataset.movielens.movie_categories()),
+                         32])
+    cat_pool = layers.sequence_pool(input=cat_emb, pool_type="sum")
+
+    title = layers.data(name="movie_title", shape=[1], dtype="int64",
+                        lod_level=1)
+    title_emb = layers.embedding(input=title, size=[5000, 32])
+    title_pool = layers.sequence_pool(input=title_emb, pool_type="sum")
+
+    return layers.fc(input=[mov_fc, cat_pool, title_pool],
+                     size=200, act="tanh"), [mid, cat, title]
+
+
+def test_recommender_system():
+    usr, usr_vars = _usr_combined()
+    mov, mov_vars = _mov_combined()
+    inference = layers.cos_sim(X=usr, Y=mov)
+    scale_infer = layers.scale(x=inference, scale=5.0)
+
+    label = layers.data(name="score", shape=[1], dtype="float32")
+    cost = layers.square_error_cost(input=scale_infer, label=label)
+    avg_cost = layers.mean(x=cost)
+    fluid.optimizer.SGD(learning_rate=0.2).minimize(avg_cost)
+
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.movielens.train(),
+                              buf_size=1024), batch_size=64)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(feed_list=usr_vars + mov_vars + [label],
+                              place=place)
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for pass_id in range(3):
+        for batch in reader():
+            if len(batch) != 64:
+                continue
+            out, = exe.run(fluid.default_main_program(),
+                           feed=feeder.feed(batch),
+                           fetch_list=[avg_cost])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert np.isfinite(losses[-1])
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]), (
+        losses[:4], losses[-4:])

@@ -1,0 +1,60 @@
+"""Book test: sentiment classification over ragged word-id sequences.
+
+Parity target: reference tests/book/test_understand_sentiment_conv.py
+(sequence_conv_pool net) and
+test_understand_sentiment_dynamic_lstm.py (stacked dynamic LSTM).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import (conv_text_classifier,
+                               stacked_lstm_text_classifier)
+
+
+def _train(model_fn, dict_dim, passes=3, batch_size=16, lr=0.05):
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    prob = model_fn(data, dict_dim)
+    cost = fluid.layers.cross_entropy(input=prob, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=prob, label=label)
+    fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+
+    word_dict = paddle.dataset.imdb.word_dict()
+    reader = paddle.batch(paddle.dataset.imdb.train(word_dict),
+                          batch_size=batch_size)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(feed_list=[data, label], place=place)
+    exe.run(fluid.default_startup_program())
+
+    losses, accs = [], []
+    for pass_id in range(passes):
+        for batch in reader():
+            if len(batch) != batch_size:
+                continue
+            loss, a = exe.run(fluid.default_main_program(),
+                              feed=feeder.feed(batch),
+                              fetch_list=[avg_cost, acc])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+            accs.append(float(np.asarray(a).reshape(-1)[0]))
+    assert np.isfinite(losses[-1])
+    head = np.mean(losses[:4])
+    tail = np.mean(losses[-4:])
+    assert tail < head, (head, tail)
+    return accs[-1]
+
+
+def test_understand_sentiment_conv():
+    dict_dim = len(paddle.dataset.imdb.word_dict())
+    _train(lambda d, n: conv_text_classifier(d, n, emb_dim=32, hid_dim=32),
+           dict_dim)
+
+
+def test_understand_sentiment_dynamic_lstm():
+    dict_dim = len(paddle.dataset.imdb.word_dict())
+    _train(lambda d, n: stacked_lstm_text_classifier(
+        d, n, emb_dim=32, hid_dim=16, stacked_num=2), dict_dim, passes=2)

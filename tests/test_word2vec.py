@@ -1,0 +1,47 @@
+"""Book test: N-gram word embedding model.
+
+Parity target: reference python/paddle/v2/fluid/tests/book/
+test_word2vec.py — 4 context words, shared embedding table, fc tower,
+cross-entropy on next-word; loss must decrease.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import word2vec_ngram
+
+
+def test_word2vec():
+    word_dict = paddle.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    names = ["firstw", "secondw", "thirdw", "forthw", "nextw"]
+    words = [fluid.layers.data(name=n, shape=[1], dtype="int64")
+             for n in names]
+    predict = word2vec_ngram(words[:4], dict_size, emb_dim=32,
+                             hidden_size=256)
+    cost = fluid.layers.cross_entropy(input=predict, label=words[4])
+    avg_cost = fluid.layers.mean(x=cost)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    train_reader = paddle.batch(paddle.dataset.imikolov.train(word_dict),
+                                batch_size=64)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(feed_list=words, place=place)
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for pass_id in range(4):
+        for data in train_reader():
+            if len(data) != 64:
+                continue
+            loss, = exe.run(fluid.default_main_program(),
+                            feed=feeder.feed(data),
+                            fetch_list=[avg_cost])
+            losses.append(float(loss[0]))
+    assert np.isfinite(losses[-1])
+    head = np.mean(losses[:8])
+    tail = np.mean(losses[-8:])
+    assert tail < head, (head, tail)
