@@ -14,6 +14,7 @@ from .reader.decorator import batch
 
 __version__ = "0.1.0"
 
-__all__ = ["reader", "dataset", "batch", "fluid"]
+__all__ = ["reader", "dataset", "batch", "fluid", "v2"]
 
 from . import fluid  # noqa: E402
+from . import v2  # noqa: E402

@@ -24,6 +24,7 @@ __all__ = [
     "linear_chain_crf", "crf_decoding", "lrn", "conv2d_transpose",
     "dynamic_lstm", "dynamic_gru", "gru_unit", "sequence_softmax",
     "sequence_slice", "lod_reset", "edit_distance", "ctc_greedy_decoder",
+    "sequence_concat",
 ]
 
 
@@ -125,6 +126,20 @@ def sequence_softmax(x=None, input=None, **kwargs):
     out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
     helper.append_op(type="sequence_softmax", inputs={"X": [x]},
                      outputs={"Out": [out]})
+    return out
+
+
+def sequence_concat(input, axis=0, **kwargs):
+    """Per-example concatenation of ragged inputs along time (axis=0) or
+    features (axis=1) (reference: sequence_concat_op.cc)."""
+    helper = LayerHelper("sequence_concat", input=input, **kwargs)
+    inputs = helper.multiple_input()
+    out = helper.create_tmp_variable(dtype=inputs[0].dtype,
+                                     lod_level=inputs[0].lod_level)
+    helper.append_op(type="sequence_concat",
+                     inputs={"X": inputs},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis})
     return out
 
 

@@ -178,16 +178,42 @@ def sequence_expand(ctx, ins, attrs):
     return {"Out": [RaggedTensor(out_vals, y.row_splits, y.nvalid)]}
 
 
+def _concat_time_pair(a, b):
+    """Per-example time concat of two lod_level-1 ragged tensors via one
+    gather: out[i] = a[i] ++ b[i]."""
+    rs_a, rs_b = a.row_splits[-1], b.row_splits[-1]
+    nseq = rs_a.shape[0] - 1
+    la = rs_a[1:] - rs_a[:-1]
+    lb = rs_b[1:] - rs_b[:-1]
+    out_splits = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(la + lb).astype(jnp.int32)])
+    n_out = a.values.shape[0] + b.values.shape[0]  # static buffer size
+    pos = jnp.arange(n_out, dtype=jnp.int32)
+    seg = jnp.clip(
+        jnp.searchsorted(out_splits, pos, side="right").astype(jnp.int32)
+        - 1, 0, nseq - 1)
+    off = pos - out_splits[seg]
+    from_a = off < la[seg]
+    src = jnp.where(from_a, rs_a[seg] + off,
+                    a.values.shape[0] + rs_b[seg] + (off - la[seg]))
+    allvals = jnp.concatenate([a.values, b.values], axis=0)
+    vals = allvals[jnp.clip(src, 0, n_out - 1)]
+    return RaggedTensor(vals, [out_splits], nvalid=a.nvalid + b.nvalid)
+
+
 @register_op("sequence_concat")
 def sequence_concat(ctx, ins, attrs):
-    """Concat along feature axis (axis=1) or time (reference:
-    sequence_concat_op.cc)."""
+    """Concat along time (axis=0, per-example sequence append) or the
+    feature axis (axis=1) (reference: sequence_concat_op.cc)."""
     xs = ins["X"]
     axis = int(attrs.get("axis", 0))
     if axis == 1:
         vals = jnp.concatenate([x.values for x in xs], axis=1)
         return {"Out": [xs[0].with_values(vals)]}
-    raise NotImplementedError("time-axis sequence_concat: use layers")
+    out = xs[0]
+    for x in xs[1:]:
+        out = _concat_time_pair(out, x)
+    return {"Out": [out]}
 
 
 @register_op("sequence_reshape")

@@ -1,0 +1,47 @@
+"""v2 inference (reference: python/paddle/v2/inference.py — infer() runs
+the topology forward over input samples)."""
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import framework
+from . import layer as v2_layer
+from .config import _place
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters=None):
+        self._outputs = (output_layer if isinstance(output_layer,
+                                                    (list, tuple))
+                         else [output_layer])
+        from ..fluid import io as fluid_io
+
+        test_prog = framework.default_main_program().clone(for_test=True)
+        self._program = fluid_io.prune_program(test_prog, self._outputs)
+        self._exe = fluid.Executor(_place())
+
+    def iter_infer_field(self, input, feeding=None, batch_size=None):
+        data_layers = list(v2_layer._data_layers)
+        if feeding is not None:
+            order = sorted(feeding.items(), key=lambda kv: kv[1])
+            by_name = {d.name: d for d in data_layers}
+            data_layers = [by_name[name] for name, _ in order]
+        # inference feeds may omit label slots: keep only as many data
+        # layers as the input tuples provide
+        width = len(input[0])
+        data_layers = data_layers[:width]
+        feeder = fluid.DataFeeder(feed_list=data_layers, place=_place())
+        outs = self._exe.run(self._program, feed=feeder.feed(input),
+                             fetch_list=list(self._outputs))
+        return [np.asarray(getattr(o, "values", o)) for o in outs]
+
+
+def infer(output_layer, parameters=None, input=None, feeding=None,
+          field="value"):
+    results = Inference(output_layer, parameters).iter_infer_field(
+        input, feeding=feeding)
+    if not isinstance(output_layer, (list, tuple)):
+        return results[0]
+    return results

@@ -1,0 +1,276 @@
+"""v2 layer builders (reference: python/paddle/v2/layer.py auto-wrapping
+trainer_config_helpers/layers.py).
+
+Each function appends fluid ops to the default Program and returns the
+fluid Variable; ``data`` additionally records declaration order so the
+trainer can map reader tuple slots without an explicit ``feeding``.
+"""
+
+from .. import fluid
+from ..fluid import layers as fl
+from . import activation as act_mod
+
+__all__ = [
+    "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
+    "lstmemory", "grumemory", "pool", "first_seq", "last_seq", "concat",
+    "dropout", "addto", "classification_cost", "cross_entropy_cost",
+    "square_error_cost", "regression_cost", "mse_cost", "crf",
+    "crf_decoding", "max_id", "seq_concat", "expand", "cos_sim",
+    "scaling", "slope_intercept", "sum_cost", "trans", "mixed",
+    "full_matrix_projection", "identity_projection", "table_projection",
+    "dotmul_projection", "context_projection",
+]
+
+# data layers in declaration order (reader tuple order by default)
+_data_layers = []
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, type):
+        act = act()
+    return act.name
+
+
+def data(name, type, **kw):
+    """reference: trainer_config_helpers data_layer; `type` is a
+    v2 data_type.InputType."""
+    v = fl.data(name=name, shape=list(type.shape), dtype=type.dtype,
+                lod_level=type.seq_level)
+    v._v2_input_type = type
+    if all(d.name != name for d in _data_layers):
+        _data_layers.append(v)
+    return v
+
+
+def _reset_data_layers():
+    del _data_layers[:]
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
+    return fl.fc(input=input, size=size, act=_act_name(act),
+                 param_attr=param_attr, bias_attr=bias_attr)
+
+
+def embedding(input, size, param_attr=None, **kw):
+    dim = input._v2_input_type.dim if hasattr(input, "_v2_input_type") \
+        else kw.pop("vocab_size")
+    return fl.embedding(input=input, size=[dim, size],
+                        param_attr=param_attr)
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=None, act=None, param_attr=None, bias_attr=None,
+             **kw):
+    if padding is None:
+        padding = (filter_size - 1) // 2
+    return fl.conv2d(input=input, num_filters=num_filters,
+                     filter_size=filter_size, stride=stride,
+                     padding=padding, act=_act_name(act),
+                     param_attr=param_attr, bias_attr=bias_attr)
+
+
+def img_pool(input, pool_size, pool_type=None, stride=None, padding=0,
+             **kw):
+    from . import pooling
+
+    if pool_type is None:
+        pool_type = pooling.Max
+    name = pool_type.name if not isinstance(pool_type, str) else pool_type
+    name = {"average": "avg"}.get(name, name)
+    return fl.pool2d(input=input, pool_size=pool_size, pool_type=name,
+                     pool_stride=stride or pool_size,
+                     pool_padding=padding)
+
+
+def batch_norm(input, act=None, **kw):
+    return fl.batch_norm(input=input, act=_act_name(act))
+
+
+def lstmemory(input, size=None, reverse=False, act=None, **kw):
+    """v2 lstmemory takes the 4h projection as input (reference:
+    trainer_config_helpers lstmemory)."""
+    if size is None:
+        size = input.shape[-1]
+    hidden, _ = fl.dynamic_lstm(
+        input=input, size=size, is_reverse=reverse,
+        candidate_activation=_act_name(act) or "tanh")
+    return hidden
+
+
+def grumemory(input, size=None, reverse=False, act=None, **kw):
+    if size is None:
+        size = input.shape[-1] // 3
+    return fl.dynamic_gru(input=input, size=size, is_reverse=reverse,
+                          candidate_activation=_act_name(act) or "tanh")
+
+
+def pool(input, pooling_type=None, **kw):
+    from . import pooling
+
+    if pooling_type is None:
+        pooling_type = pooling.Max
+    name = pooling_type.name if not isinstance(pooling_type, str) \
+        else pooling_type
+    return fl.sequence_pool(input=input, pool_type=name)
+
+
+def first_seq(input, **kw):
+    return fl.sequence_first_step(input=input)
+
+
+def last_seq(input, **kw):
+    return fl.sequence_last_step(input=input)
+
+
+def concat(input, act=None, **kw):
+    out = fl.concat(input=input, axis=-1)
+    name = _act_name(act)
+    if name:
+        out = getattr(fl, name)(out)
+    return out
+
+
+def seq_concat(a, b, **kw):
+    return fl.sequence_concat(input=[a, b])
+
+
+def dropout(input, dropout_rate, **kw):
+    return fl.dropout(x=input, dropout_prob=dropout_rate)
+
+
+def addto(input, act=None, bias_attr=None, **kw):
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    out = fl.sums(input=list(input))
+    name = _act_name(act)
+    if name:
+        out = getattr(fl, name)(out)
+    return out
+
+
+def classification_cost(input, label, **kw):
+    """softmax-prob input + int label -> mean cross-entropy (reference:
+    trainer_config_helpers classification_cost)."""
+    cost = fl.cross_entropy(input=input, label=label)
+    return fl.mean(x=cost)
+
+
+def cross_entropy_cost(input, label, **kw):
+    return classification_cost(input, label)
+
+
+def square_error_cost(input, label, **kw):
+    cost = fl.square_error_cost(input=input, label=label)
+    return fl.mean(x=cost)
+
+
+regression_cost = square_error_cost
+mse_cost = square_error_cost
+
+
+def sum_cost(input, **kw):
+    return fl.mean(x=input)
+
+
+def crf(size, input, label, param_attr=None, **kw):
+    ll = fl.linear_chain_crf(input=input, label=label,
+                             param_attr=param_attr)
+    return fl.mean(x=ll)
+
+
+def crf_decoding(size, input, param_attr=None, label=None, **kw):
+    return fl.crf_decoding(input=input, param_attr=param_attr,
+                           label=label)
+
+
+def max_id(input, **kw):
+    _, idx = fl.topk(input=input, k=1)
+    return idx
+
+
+def expand(input, expand_as, **kw):
+    return fl.sequence_expand(x=input, y=expand_as)
+
+
+def cos_sim(a, b, scale=1.0, **kw):
+    out = fl.cos_sim(X=a, Y=b)
+    if scale != 1.0:
+        out = fl.scale(x=out, scale=float(scale))
+    return out
+
+
+def scaling(input, weight, **kw):
+    return fl.elementwise_mul(x=input, y=weight)
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, **kw):
+    out = fl.scale(x=input, scale=float(slope))
+    if intercept:
+        out = out + float(intercept)
+    return out
+
+
+def trans(input, **kw):
+    return fl.transpose(x=input, perm=[1, 0])
+
+
+# ---------------------------------------------------------------------------
+# mixed layer + projections (reference: trainer_config_helpers
+# mixed_layer + FullMatrixProjection/TableProjection/... — a mixed layer
+# sums its projections; here each projection is a deferred builder)
+# ---------------------------------------------------------------------------
+
+class _Projection:
+    def __init__(self, build):
+        self.build = build
+
+
+def full_matrix_projection(input, size, param_attr=None):
+    return _Projection(lambda: fl.fc(input=input, size=size,
+                                     bias_attr=False,
+                                     param_attr=param_attr))
+
+
+def identity_projection(input, offset=None):
+    if offset:
+        raise NotImplementedError("identity_projection offset")
+    return _Projection(lambda: input)
+
+
+def table_projection(input, size, param_attr=None):
+    dim = input._v2_input_type.dim
+    return _Projection(lambda: fl.embedding(input=input, size=[dim, size],
+                                            param_attr=param_attr))
+
+
+def dotmul_projection(input, param_attr=None):
+    def build():
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("dotmul_projection",
+                             param_attr=param_attr)
+        w = helper.create_parameter(helper.param_attr,
+                                    shape=[input.shape[-1]],
+                                    dtype=input.dtype)
+        return fl.elementwise_mul(x=input, y=w)
+
+    return _Projection(build)
+
+
+def context_projection(input, context_len, context_start=None):
+    return _Projection(lambda: fl.sequence_conv(
+        input=input, num_filters=input.shape[-1],
+        filter_size=context_len, bias_attr=False))
+
+
+def mixed(size=None, input=None, act=None, bias_attr=None, **kw):
+    outs = [p.build() if isinstance(p, _Projection) else p
+            for p in (input if isinstance(input, (list, tuple))
+                      else [input])]
+    out = outs[0] if len(outs) == 1 else fl.sums(input=outs)
+    name = _act_name(act)
+    if name:
+        out = getattr(fl, name)(out)
+    return out

@@ -1,0 +1,56 @@
+"""v2 network composites (reference: python/paddle/v2/networks.py over
+trainer_config_helpers/networks.py)."""
+
+from ..fluid import nets as fluid_nets
+from . import layer as v2_layer
+from . import activation as act_mod
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "simple_lstm", "bidirectional_lstm", "simple_gru"]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, **kw):
+    return fluid_nets.simple_img_conv_pool(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        pool_size=pool_size, pool_stride=pool_stride,
+        act=v2_layer._act_name(act))
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, pool_stride=1, **kw):
+    return fluid_nets.img_conv_group(
+        input=input, conv_num_filter=conv_num_filter,
+        pool_size=pool_size, conv_padding=conv_padding,
+        conv_filter_size=conv_filter_size,
+        conv_act=v2_layer._act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm,
+        pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, **kw):
+    return fluid_nets.sequence_conv_pool(
+        input=input, num_filters=hidden_size, filter_size=context_len)
+
+
+def simple_lstm(input, size, reverse=False, **kw):
+    proj = v2_layer.fc(input=input, size=size * 4)
+    return v2_layer.lstmemory(input=proj, size=size * 4, reverse=reverse)
+
+
+def bidirectional_lstm(input, size, return_unpooled=False, **kw):
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_unpooled:
+        return fwd, bwd
+    from . import pooling
+
+    f = v2_layer.pool(fwd, pooling_type=pooling.Max)
+    b = v2_layer.pool(bwd, pooling_type=pooling.Max)
+    return v2_layer.concat(input=[f, b])
+
+
+def simple_gru(input, size, reverse=False, **kw):
+    proj = v2_layer.fc(input=input, size=size * 3)
+    return v2_layer.grumemory(input=proj, size=size, reverse=reverse)

@@ -1,0 +1,80 @@
+"""v2 SGD trainer: event-driven train loop over the fluid executor
+(reference: python/paddle/v2/trainer.py — SGD:37, train:137-215; there
+it drives a GradientMachine through SWIG, here it drives a compiled
+fluid Program)."""
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import framework
+from . import event as v2_event
+from . import layer as v2_layer
+from .config import _place
+
+__all__ = ["SGD"]
+
+
+class SGD:
+    """reference: v2/trainer.py SGD — cost topology + parameters +
+    update_equation."""
+
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True):
+        self._cost = cost
+        self._parameters = parameters
+        self._extra = extra_layers or []
+        self._main_program = framework.default_main_program()
+
+        opt = update_equation
+        if hasattr(opt, "to_fluid"):
+            opt = opt.to_fluid()
+        self._optimizer = opt
+        self._optimize_ops, self._params_grads = opt.minimize(cost)
+        # params created by minimize (accumulators) need startup run
+        exe = fluid.Executor(_place())
+        exe.run(framework.default_startup_program())
+        self._exe = exe
+
+    def _feeder(self, feeding):
+        data_layers = list(v2_layer._data_layers)
+        if feeding is not None:
+            order = sorted(feeding.items(), key=lambda kv: kv[1])
+            by_name = {d.name: d for d in data_layers}
+            data_layers = [by_name[name] for name, _ in order]
+        return fluid.DataFeeder(feed_list=data_layers, place=_place())
+
+    def train(self, reader, num_passes=1, event_handler=None,
+              feeding=None):
+        if event_handler is None:
+            event_handler = lambda e: None
+        feeder = self._feeder(feeding)
+        fetch = [self._cost] + list(self._extra)
+
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_costs = []
+            for batch_id, data in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                outs = self._exe.run(self._main_program,
+                                     feed=feeder.feed(data),
+                                     fetch_list=fetch)
+                cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                pass_costs.append(cost)
+                event_handler(v2_event.EndForwardBackward(
+                    pass_id, batch_id))
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, cost))
+            event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader, feeding=None):
+        """Run the cost over a reader without updating parameters
+        (reference: v2/trainer.py test — forward only)."""
+        test_program = self._main_program.clone(for_test=True)
+        feeder = self._feeder(feeding)
+        costs, n = [], 0
+        for data in reader():
+            outs = self._exe.run(test_program, feed=feeder.feed(data),
+                                 fetch_list=[self._cost])
+            costs.append(float(np.asarray(outs[0]).reshape(-1)[0]))
+            n += len(data)
+        return v2_event.TestResult(cost=float(np.mean(costs)))

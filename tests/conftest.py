@@ -30,10 +30,13 @@ def fresh_programs():
     from paddle_tpu.fluid import framework
     from paddle_tpu.core import scope as scope_mod
 
+    from paddle_tpu.v2 import layer as v2_layer
+
     old_main = framework.switch_main_program(framework.Program())
     old_startup = framework.switch_startup_program(framework.Program())
     old_scope = scope_mod._global_scope
     scope_mod._global_scope = scope_mod.Scope()
+    v2_layer._reset_data_layers()
     yield
     framework.switch_main_program(old_main)
     framework.switch_startup_program(old_startup)

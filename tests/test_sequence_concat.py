@@ -1,0 +1,40 @@
+"""sequence_concat op: time-axis (per-example append) and feature-axis
+modes (reference: sequence_concat_op.cc + its py test)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _run(axis, A, B):
+    a = fluid.layers.data(name="a", shape=[2], dtype="float32",
+                          lod_level=1)
+    b = fluid.layers.data(name="b", shape=[2], dtype="float32",
+                          lod_level=1)
+    out = fluid.layers.sequence_concat(input=[a, b], axis=axis)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(feed_list=[a, b], place=place)
+    res, = exe.run(fluid.default_main_program(),
+                   feed=feeder.feed(list(zip(A, B))),
+                   fetch_list=[out])
+    return res
+
+
+def test_sequence_concat_time_axis():
+    A = [[[1, 1], [2, 2]], [[3, 3]]]
+    B = [[[9, 9]], [[8, 8], [7, 7]]]
+    res = _run(0, A, B)
+    vals = np.asarray(res.values)[:int(res.nvalid)]
+    assert vals.tolist() == [[1, 1], [2, 2], [9, 9],
+                             [3, 3], [8, 8], [7, 7]]
+    assert res.lod() == [[0, 3, 6]]
+
+
+def test_sequence_concat_feature_axis():
+    A = [[[1, 1], [2, 2]], [[3, 3]]]
+    B = [[[9, 9], [6, 6]], [[8, 8]]]
+    res = _run(1, A, B)
+    vals = np.asarray(res.values)[:int(res.nvalid)]
+    assert vals.shape[1] == 4
+    assert vals[0].tolist() == [1, 1, 9, 9]

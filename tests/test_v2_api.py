@@ -1,0 +1,145 @@
+"""v2 API parity tests (reference: python/paddle/v2 — the event-driven
+SGD trainer, Parameters tar round-trip, paddle.infer, and the v2 layer
+DSL over fluid)."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def test_v2_fit_a_line():
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(13))
+    y_predict = paddle.layer.fc(input=x, size=1,
+                                act=paddle.activation.Linear())
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.square_error_cost(input=y_predict, label=y)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(momentum=0.9,
+                                          learning_rate=1e-3)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    costs = []
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500), batch_size=20)
+    trainer.train(reader=reader, num_passes=12,
+                  event_handler=event_handler,
+                  feeding={"x": 0, "y": 1})
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+    # test() runs forward-only
+    result = trainer.test(reader=paddle.batch(
+        paddle.dataset.uci_housing.test(), batch_size=20),
+        feeding={"x": 0, "y": 1})
+    assert np.isfinite(result.cost)
+
+    # Parameters: numpy access + tar round-trip
+    keys = parameters.keys()
+    assert len(keys) >= 2  # weight + bias
+    w = parameters.get(keys[0])
+    buf = io.BytesIO()
+    parameters.to_tar(buf)
+    parameters.set(keys[0], np.zeros_like(w))
+    assert np.allclose(parameters.get(keys[0]), 0)
+    buf.seek(0)
+    parameters.init_from_tar(buf)
+    assert np.allclose(parameters.get(keys[0]), w)
+
+    # infer
+    test_data = [(s[0],) for s in paddle.dataset.uci_housing.test()()][:8]
+    probs = paddle.infer(output_layer=y_predict, parameters=parameters,
+                         input=test_data, feeding={"x": 0, "y": 1})
+    assert probs.shape[0] == 8
+    assert np.all(np.isfinite(probs))
+
+
+def test_v2_mnist_convnet():
+    paddle.init()
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_array(
+                                   784, [1, 28, 28]))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(10))
+    conv_pool = paddle.networks.simple_img_conv_pool(
+        input=images, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=conv_pool, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+
+    costs = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+
+    import paddle_tpu
+
+    reader = paddle.batch(paddle_tpu.dataset.mnist.train(),
+                          batch_size=32)
+
+    def limited():
+        for i, b in enumerate(reader()):
+            if i >= 12:
+                return
+            yield b
+
+    trainer.train(reader=limited, num_passes=1, event_handler=handler)
+    assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
+
+
+def test_v2_sequence_lstm():
+    paddle.init()
+    data = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(200))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=data, size=16)
+    lstm = paddle.networks.simple_lstm(input=emb, size=8)
+    pooled = paddle.layer.pool(input=lstm,
+                               pooling_type=paddle.pooling.Max())
+    predict = paddle.layer.fc(input=pooled, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    rs = np.random.RandomState(3)
+
+    def reader():
+        for _ in range(10):
+            batch = []
+            for _ in range(8):
+                n = int(rs.randint(3, 12))
+                words = rs.randint(0, 200, size=n).tolist()
+                lab = int(sum(words) % 2)
+                batch.append((words, lab))
+            yield batch
+
+    costs = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+
+    trainer.train(reader=reader, num_passes=2, event_handler=handler)
+    assert np.isfinite(costs[-1])
