@@ -1,0 +1,170 @@
+// See transport.h.  POSIX sockets, thread per connection (the reference
+// pserver similarly dedicates threads per channel:
+// paddle/pserver/SocketChannel.h, LightNetwork.h worker threads).
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+namespace ptrt {
+
+static bool writeAll(int fd, const void *p, size_t n) {
+  const char *b = static_cast<const char *>(p);
+  while (n > 0) {
+    ssize_t k = ::write(fd, b, n);
+    if (k <= 0) return false;
+    b += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+static bool readAll(int fd, void *p, size_t n) {
+  char *b = static_cast<char *>(p);
+  while (n > 0) {
+    ssize_t k = ::read(fd, b, n);
+    if (k <= 0) return false;
+    b += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool sendFrame(int fd, uint32_t opcode, const void *payload, uint64_t len) {
+  uint8_t hdr[12];
+  memcpy(hdr, &opcode, 4);
+  memcpy(hdr + 4, &len, 8);
+  if (!writeAll(fd, hdr, 12)) return false;
+  return len == 0 || writeAll(fd, payload, len);
+}
+
+bool recvFrame(int fd, uint32_t *opcode, std::vector<uint8_t> *payload) {
+  uint8_t hdr[12];
+  if (!readAll(fd, hdr, 12)) return false;
+  uint64_t len;
+  memcpy(opcode, hdr, 4);
+  memcpy(&len, hdr + 4, 8);
+  if (len > (1ull << 33)) return false;  // sanity cap 8GB
+  payload->resize(len);
+  return len == 0 || readAll(fd, payload->data(), len);
+}
+
+Server::Server(int port, Handler handler) : handler_(std::move(handler)) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return;
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr *>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(listen_fd_, 128) != 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr *>(&addr), &alen);
+  port_ = ntohs(addr.sin_port);
+  accept_thread_ = std::thread([this] { acceptLoop(); });
+}
+
+Server::~Server() { stop(); }
+
+void Server::stop() {
+  bool expected = false;
+  if (!stopping_.compare_exchange_strong(expected, true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  {
+    // unblock connection threads stuck in read() on live clients
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto &t : conns_)
+    if (t.joinable()) t.join();
+  conns_.clear();
+}
+
+void Server::acceptLoop() {
+  while (!stopping_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) break;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    {
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.push_back(fd);
+    }
+    conns_.emplace_back([this, fd] { serveConn(fd); });
+  }
+}
+
+void Server::serveConn(int fd) {
+  std::vector<uint8_t> payload;
+  uint32_t opcode;
+  while (!stopping_.load() && recvFrame(fd, &opcode, &payload)) {
+    Reader r(payload.data(), payload.size());
+    Writer w;
+    handler_(opcode, r, w);
+    if (!sendFrame(fd, opcode, w.buf.data(), w.buf.size())) break;
+  }
+  {
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (size_t i = 0; i < conn_fds_.size(); ++i) {
+      if (conn_fds_[i] == fd) {
+        conn_fds_.erase(conn_fds_.begin() + i);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+Client::Client(const std::string &host, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (host.empty() || host == "localhost" || host == "127.0.0.1")
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  else if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd_);
+    fd_ = -1;
+    return;
+  }
+  if (::connect(fd_, reinterpret_cast<sockaddr *>(&addr),
+                sizeof(addr)) != 0) {
+    ::close(fd_);
+    fd_ = -1;
+    return;
+  }
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+Client::~Client() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+bool Client::call(uint32_t opcode, const Writer &req,
+                  std::vector<uint8_t> *resp) {
+  if (fd_ < 0) return false;
+  if (!sendFrame(fd_, opcode, req.buf.data(), req.buf.size())) return false;
+  uint32_t op2;
+  return recvFrame(fd_, &op2, resp) && op2 == opcode;
+}
+
+}  // namespace ptrt

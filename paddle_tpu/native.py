@@ -1,0 +1,338 @@
+"""ctypes bindings to the native C++ runtime (native/libpaddle_tpu_rt.so).
+
+The native library provides the services the reference implements in
+C++/Go rather than Python (reference: paddle/pserver/ParameterServer2,
+go/master/service.go, recordio, paddle/memory BuddyAllocator); the TPU
+compute path stays in XLA — this layer is the host/DCN runtime around
+it.  Built on demand with `make` (g++, no external deps).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["lib", "ParameterServer", "PServerClient", "Master",
+           "MasterClient", "RecordIOWriter", "RecordIOReader",
+           "BuddyAllocator", "OPT_SGD", "OPT_MOMENTUM", "OPT_ADAGRAD",
+           "OPT_ADAM"]
+
+OPT_SGD = 0
+OPT_MOMENTUM = 1
+OPT_ADAGRAD = 2
+OPT_ADAM = 3
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libpaddle_tpu_rt.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _build():
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def lib():
+    """Load (building if needed) the native runtime library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            _build()
+        L = ctypes.CDLL(_SO_PATH)
+        c = ctypes
+        sigs = {
+            "ptrt_pserver_start": (c.c_void_p, [c.c_int, c.c_int, c.c_int]),
+            "ptrt_pserver_stop": (None, [c.c_void_p]),
+            "ptrt_pserver_port": (c.c_int, [c.c_void_p]),
+            "ptrt_pserver_save": (c.c_int, [c.c_void_p, c.c_char_p]),
+            "ptrt_pserver_load": (c.c_int, [c.c_void_p, c.c_char_p]),
+            "ptrt_pserver_num_updates": (c.c_int64, [c.c_void_p]),
+            "ptrt_client_connect": (c.c_void_p, [c.c_char_p, c.c_int]),
+            "ptrt_client_close": (None, [c.c_void_p]),
+            "ptrt_client_init_param":
+                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64,
+                           c.c_int, c.c_double, c.c_double, c.c_double,
+                           c.c_double]),
+            "ptrt_client_send_grad":
+                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64,
+                           c.c_void_p]),
+            "ptrt_client_get_param":
+                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64]),
+            "ptrt_client_send_sparse_grad":
+                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_void_p,
+                           c.c_int64, c.c_int64]),
+            "ptrt_client_get_rows":
+                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_void_p,
+                           c.c_int64, c.c_int64]),
+            "ptrt_client_barrier": (c.c_int, [c.c_void_p]),
+            "ptrt_master_start": (c.c_void_p, [c.c_int, c.c_int, c.c_int]),
+            "ptrt_master_stop": (None, [c.c_void_p]),
+            "ptrt_master_port": (c.c_int, [c.c_void_p]),
+            "ptrt_master_snapshot": (c.c_int, [c.c_void_p, c.c_char_p]),
+            "ptrt_master_recover": (c.c_int, [c.c_void_p, c.c_char_p]),
+            "ptrt_mclient_connect": (c.c_void_p, [c.c_char_p, c.c_int]),
+            "ptrt_mclient_close": (None, [c.c_void_p]),
+            "ptrt_mclient_set_dataset":
+                (c.c_int, [c.c_void_p, c.POINTER(c.c_char_p), c.c_int,
+                           c.c_int]),
+            "ptrt_mclient_get_task":
+                (c.c_int64, [c.c_void_p, c.c_char_p, c.c_int64]),
+            "ptrt_mclient_task_finished": (c.c_int, [c.c_void_p, c.c_int64]),
+            "ptrt_mclient_task_failed": (c.c_int, [c.c_void_p, c.c_int64]),
+            "ptrt_recordio_writer_open": (c.c_void_p, [c.c_char_p]),
+            "ptrt_recordio_write":
+                (c.c_int, [c.c_void_p, c.c_void_p, c.c_int64]),
+            "ptrt_recordio_writer_close": (c.c_int, [c.c_void_p]),
+            "ptrt_recordio_reader_open": (c.c_void_p, [c.c_char_p]),
+            "ptrt_recordio_read":
+                (c.c_int64, [c.c_void_p, c.c_void_p, c.c_int64]),
+            "ptrt_recordio_reader_close": (None, [c.c_void_p]),
+            "ptrt_buddy_create": (c.c_void_p, [c.c_int64, c.c_int64]),
+            "ptrt_buddy_alloc": (c.c_void_p, [c.c_void_p, c.c_int64]),
+            "ptrt_buddy_free": (None, [c.c_void_p, c.c_void_p]),
+            "ptrt_buddy_used": (c.c_int64, [c.c_void_p]),
+            "ptrt_buddy_destroy": (None, [c.c_void_p]),
+        }
+        for name, (restype, argtypes) in sigs.items():
+            fn = getattr(L, name)
+            fn.restype = restype
+            fn.argtypes = argtypes
+        _lib = L
+        return _lib
+
+
+def _f32(a):
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+class ParameterServer:
+    """In-process pserver (reference: ParameterServerController starts
+    pservers in-process for tests; production runs one per host)."""
+
+    def __init__(self, port=0, num_trainers=1, sync=True):
+        self._h = lib().ptrt_pserver_start(port, num_trainers,
+                                           1 if sync else 0)
+
+    @property
+    def port(self):
+        return lib().ptrt_pserver_port(self._h)
+
+    def num_updates(self):
+        return lib().ptrt_pserver_num_updates(self._h)
+
+    def save(self, path):
+        return lib().ptrt_pserver_save(self._h, path.encode())
+
+    def load(self, path):
+        return lib().ptrt_pserver_load(self._h, path.encode())
+
+    def stop(self):
+        if self._h:
+            lib().ptrt_pserver_stop(self._h)
+            self._h = None
+
+
+class PServerClient:
+    def __init__(self, host, port):
+        self._h = lib().ptrt_client_connect(host.encode(), port)
+        if not self._h:
+            raise ConnectionError("cannot connect to pserver %s:%d"
+                                  % (host, port))
+
+    def init_param(self, name, value, opt_kind=OPT_SGD, lr=0.01,
+                   hp1=0.0, hp2=0.0, hp3=0.0):
+        v = _f32(value).reshape(-1)
+        rc = lib().ptrt_client_init_param(
+            self._h, name.encode(), v.ctypes.data_as(ctypes.c_void_p),
+            v.size, opt_kind, lr, hp1, hp2, hp3)
+        if rc != 0:
+            raise RuntimeError("init_param(%s) rc=%d" % (name, rc))
+
+    def send_grad(self, name, grad):
+        """Blocking: returns the freshly updated parameter (sync mode
+        waits for all trainers' gradients)."""
+        g = _f32(grad).reshape(-1)
+        out = np.empty_like(g)
+        rc = lib().ptrt_client_send_grad(
+            self._h, name.encode(), g.ctypes.data_as(ctypes.c_void_p),
+            g.size, out.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise RuntimeError("send_grad(%s) rc=%d" % (name, rc))
+        return out
+
+    def get_param(self, name, size):
+        out = np.empty(size, np.float32)
+        rc = lib().ptrt_client_get_param(
+            self._h, name.encode(), out.ctypes.data_as(ctypes.c_void_p),
+            out.size)
+        if rc != 0:
+            raise RuntimeError("get_param(%s) rc=%d" % (name, rc))
+        return out
+
+    def send_sparse_grad(self, name, rows, values):
+        rows = np.ascontiguousarray(rows, np.int32)
+        vals = _f32(values)
+        assert vals.ndim == 2 and vals.shape[0] == rows.size
+        rc = lib().ptrt_client_send_sparse_grad(
+            self._h, name.encode(),
+            rows.ctypes.data_as(ctypes.c_void_p),
+            vals.ctypes.data_as(ctypes.c_void_p), rows.size,
+            vals.shape[1])
+        if rc != 0:
+            raise RuntimeError("send_sparse_grad(%s) rc=%d" % (name, rc))
+
+    def get_rows(self, name, rows, width):
+        rows = np.ascontiguousarray(rows, np.int32)
+        out = np.empty((rows.size, width), np.float32)
+        rc = lib().ptrt_client_get_rows(
+            self._h, name.encode(),
+            rows.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), rows.size, width)
+        if rc != 0:
+            raise RuntimeError("get_rows(%s) rc=%d" % (name, rc))
+        return out
+
+    def barrier(self):
+        rc = lib().ptrt_client_barrier(self._h)
+        if rc != 0:
+            raise RuntimeError("barrier rc=%d" % rc)
+
+    def close(self):
+        if self._h:
+            lib().ptrt_client_close(self._h)
+            self._h = None
+
+
+class Master:
+    def __init__(self, port=0, timeout_ms=5000, failure_max=3):
+        self._h = lib().ptrt_master_start(port, timeout_ms, failure_max)
+
+    @property
+    def port(self):
+        return lib().ptrt_master_port(self._h)
+
+    def snapshot(self, path):
+        return lib().ptrt_master_snapshot(self._h, path.encode())
+
+    def recover(self, path):
+        return lib().ptrt_master_recover(self._h, path.encode())
+
+    def stop(self):
+        if self._h:
+            lib().ptrt_master_stop(self._h)
+            self._h = None
+
+
+class MasterClient:
+    PASS_FINISHED = -2
+    NO_TASK = -1
+
+    def __init__(self, host, port):
+        self._h = lib().ptrt_mclient_connect(host.encode(), port)
+        if not self._h:
+            raise ConnectionError("cannot connect to master %s:%d"
+                                  % (host, port))
+
+    def set_dataset(self, chunk_paths, chunks_per_task=1):
+        arr = (ctypes.c_char_p * len(chunk_paths))(
+            *[p.encode() for p in chunk_paths])
+        rc = lib().ptrt_mclient_set_dataset(self._h, arr,
+                                            len(chunk_paths),
+                                            chunks_per_task)
+        if rc != 0:
+            raise RuntimeError("set_dataset rc=%d" % rc)
+
+    def get_task(self):
+        """Returns (task_id, [chunk paths]); task_id is NO_TASK/-1 when
+        tasks are leased out, PASS_FINISHED/-2 when the pass is done."""
+        buf = ctypes.create_string_buffer(1 << 20)
+        tid = lib().ptrt_mclient_get_task(self._h, buf, len(buf))
+        if tid < 0:
+            return tid, []
+        chunks = buf.value.decode().split("\n") if buf.value else []
+        return tid, chunks
+
+    def task_finished(self, task_id):
+        lib().ptrt_mclient_task_finished(self._h, task_id)
+
+    def task_failed(self, task_id):
+        lib().ptrt_mclient_task_failed(self._h, task_id)
+
+    def close(self):
+        if self._h:
+            lib().ptrt_mclient_close(self._h)
+            self._h = None
+
+
+class RecordIOWriter:
+    def __init__(self, path):
+        self._h = lib().ptrt_recordio_writer_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def write(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        rc = lib().ptrt_recordio_write(self._h, data, len(data))
+        if rc != 0:
+            raise IOError("write failed rc=%d" % rc)
+
+    def close(self):
+        if self._h:
+            lib().ptrt_recordio_writer_close(self._h)
+            self._h = None
+
+
+class RecordIOReader:
+    def __init__(self, path, max_record=1 << 24):
+        self._h = lib().ptrt_recordio_reader_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+        self._buf = ctypes.create_string_buffer(max_record)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = lib().ptrt_recordio_read(self._h, self._buf, len(self._buf))
+        if n == -1:
+            raise StopIteration
+        if n < 0:
+            raise IOError("corrupt record (rc=%d)" % n)
+        return self._buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            lib().ptrt_recordio_reader_close(self._h)
+            self._h = None
+
+
+class BuddyAllocator:
+    def __init__(self, total_bytes, min_block=64):
+        self._h = lib().ptrt_buddy_create(total_bytes, min_block)
+
+    def alloc(self, n):
+        p = lib().ptrt_buddy_alloc(self._h, n)
+        if not p:
+            raise MemoryError("buddy pool exhausted (%d bytes)" % n)
+        return p
+
+    def free(self, p):
+        lib().ptrt_buddy_free(self._h, p)
+
+    @property
+    def used(self):
+        return lib().ptrt_buddy_used(self._h)
+
+    def destroy(self):
+        if self._h:
+            lib().ptrt_buddy_destroy(self._h)
+            self._h = None

@@ -18,20 +18,30 @@ class Inference:
                          else [output_layer])
         from ..fluid import io as fluid_io
 
-        test_prog = framework.default_main_program().clone(for_test=True)
-        self._program = fluid_io.prune_program(test_prog, self._outputs)
+        self._source = framework.default_main_program()
+        self._program = fluid_io.prune_program(self._source,
+                                               self._outputs)
+
+        # feed slots the pruned program actually consumes
+        used = set()
+        for op in self._program.global_block().desc.ops:
+            for ns in op.inputs.values():
+                used.update(ns)
+        self._used_inputs = used
         self._exe = fluid.Executor(_place())
 
     def iter_infer_field(self, input, feeding=None, batch_size=None):
-        data_layers = list(v2_layer._data_layers)
-        if feeding is not None:
-            order = sorted(feeding.items(), key=lambda kv: kv[1])
-            by_name = {d.name: d for d in data_layers}
-            data_layers = [by_name[name] for name, _ in order]
-        # inference feeds may omit label slots: keep only as many data
-        # layers as the input tuples provide
+        data_layers = [
+            d for d in v2_layer.data_layers_for_feeding(
+                feeding, self._source)
+            if d.name in self._used_inputs]
         width = len(input[0])
-        data_layers = data_layers[:width]
+        if len(data_layers) != width:
+            raise ValueError(
+                "inference needs %d feed slots (%s) but input tuples "
+                "have %d fields"
+                % (len(data_layers), [d.name for d in data_layers],
+                   width))
         feeder = fluid.DataFeeder(feed_list=data_layers, place=_place())
         outs = self._exe.run(self._program, feed=feeder.feed(input),
                              fetch_list=list(self._outputs))

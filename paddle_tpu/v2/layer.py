@@ -21,10 +21,6 @@ __all__ = [
     "dotmul_projection", "context_projection",
 ]
 
-# data layers in declaration order (reader tuple order by default)
-_data_layers = []
-
-
 def _act_name(act):
     if act is None:
         return None
@@ -33,19 +29,44 @@ def _act_name(act):
     return act.name
 
 
+def _program_data_layers(program=None):
+    """Data layers in declaration order, tracked per Program so a second
+    topology in the same process doesn't inherit stale feed slots."""
+    from ..fluid import framework
+
+    if program is None:
+        program = framework.default_main_program()
+    if not hasattr(program, "_v2_data_layers"):
+        program._v2_data_layers = []
+    return program._v2_data_layers
+
+
 def data(name, type, **kw):
     """reference: trainer_config_helpers data_layer; `type` is a
     v2 data_type.InputType."""
     v = fl.data(name=name, shape=list(type.shape), dtype=type.dtype,
                 lod_level=type.seq_level)
     v._v2_input_type = type
-    if all(d.name != name for d in _data_layers):
-        _data_layers.append(v)
+    registry = _program_data_layers()
+    if all(d.name != name for d in registry):
+        registry.append(v)
     return v
 
 
-def _reset_data_layers():
-    del _data_layers[:]
+def data_layers_for_feeding(feeding, program=None):
+    """Resolve reader tuple order: declaration order by default,
+    reordered by an explicit {name: index} feeding map."""
+    layers = list(_program_data_layers(program))
+    if feeding is not None:
+        by_name = {d.name: d for d in layers}
+        layers = [by_name[name]
+                  for name, _ in sorted(feeding.items(),
+                                        key=lambda kv: kv[1])]
+    return layers
+
+
+def _reset_data_layers(program=None):
+    del _program_data_layers(program)[:]
 
 
 def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
@@ -89,12 +110,13 @@ def batch_norm(input, act=None, **kw):
 
 
 def lstmemory(input, size=None, reverse=False, act=None, **kw):
-    """v2 lstmemory takes the 4h projection as input (reference:
-    trainer_config_helpers lstmemory)."""
+    """v2 lstmemory: `size` is the hidden width and `input` the 4*size
+    projection (reference: trainer_config_helpers lstmemory — hidden
+    size, matching grumemory; fluid dynamic_lstm instead takes 4h)."""
     if size is None:
-        size = input.shape[-1]
+        size = input.shape[-1] // 4
     hidden, _ = fl.dynamic_lstm(
-        input=input, size=size, is_reverse=reverse,
+        input=input, size=size * 4, is_reverse=reverse,
         candidate_activation=_act_name(act) or "tanh")
     return hidden
 

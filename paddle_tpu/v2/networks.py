@@ -36,7 +36,7 @@ def sequence_conv_pool(input, context_len, hidden_size, **kw):
 
 def simple_lstm(input, size, reverse=False, **kw):
     proj = v2_layer.fc(input=input, size=size * 4)
-    return v2_layer.lstmemory(input=proj, size=size * 4, reverse=reverse)
+    return v2_layer.lstmemory(input=proj, size=size, reverse=reverse)
 
 
 def bidirectional_lstm(input, size, return_unpooled=False, **kw):

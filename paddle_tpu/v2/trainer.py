@@ -30,18 +30,46 @@ class SGD:
             opt = opt.to_fluid()
         self._optimizer = opt
         self._optimize_ops, self._params_grads = opt.minimize(cost)
-        # params created by minimize (accumulators) need startup run
         exe = fluid.Executor(_place())
-        exe.run(framework.default_startup_program())
+        self._run_startup_for_missing(exe)
         self._exe = exe
 
+    @staticmethod
+    def _run_startup_for_missing(exe):
+        """Initialize only variables that have no value yet, so weights
+        loaded via Parameters before trainer construction survive
+        (minimize() adds optimizer accumulators that still need init)."""
+        from ..core import scope as scope_mod
+
+        startup = framework.default_startup_program()
+        scope = scope_mod.global_scope()
+        pending = framework.Program()
+        dst = pending.global_block()
+        needed = False
+        src = startup.global_block()
+        for op in src.desc.ops:
+            out_names = [n for ns in op.outputs.values() for n in ns]
+            if all(scope.get(n) is not None for n in out_names):
+                continue
+            for name in out_names:
+                if name not in dst.vars and name in src.vars:
+                    v = src.vars[name]
+                    dst.create_var(
+                        name=v.name, shape=v.shape, dtype=v.dtype,
+                        type=v.type, persistable=v.persistable,
+                        lod_level=v.lod_level)
+            dst.append_op(type=op.type, inputs=dict(op.inputs),
+                          outputs=dict(op.outputs),
+                          attrs=dict(op.attrs), infer_shape=False)
+            needed = True
+        if needed:
+            exe.run(pending)
+
     def _feeder(self, feeding):
-        data_layers = list(v2_layer._data_layers)
-        if feeding is not None:
-            order = sorted(feeding.items(), key=lambda kv: kv[1])
-            by_name = {d.name: d for d in data_layers}
-            data_layers = [by_name[name] for name, _ in order]
-        return fluid.DataFeeder(feed_list=data_layers, place=_place())
+        return fluid.DataFeeder(
+            feed_list=v2_layer.data_layers_for_feeding(
+                feeding, self._main_program),
+            place=_place())
 
     def train(self, reader, num_passes=1, event_handler=None,
               feeding=None):
@@ -68,13 +96,17 @@ class SGD:
 
     def test(self, reader, feeding=None):
         """Run the cost over a reader without updating parameters
-        (reference: v2/trainer.py test — forward only)."""
-        test_program = self._main_program.clone(for_test=True)
+        (reference: v2/trainer.py test — forward only; the program is
+        pruned to the cost so backward/optimizer ops don't run)."""
+        from ..fluid import io as fluid_io
+
+        test_program = fluid_io.prune_program(self._main_program,
+                                              [self._cost])
         feeder = self._feeder(feeding)
-        costs, n = [], 0
+        total, n = 0.0, 0
         for data in reader():
             outs = self._exe.run(test_program, feed=feeder.feed(data),
                                  fetch_list=[self._cost])
-            costs.append(float(np.asarray(outs[0]).reshape(-1)[0]))
+            total += float(np.asarray(outs[0]).reshape(-1)[0]) * len(data)
             n += len(data)
-        return v2_event.TestResult(cost=float(np.mean(costs)))
+        return v2_event.TestResult(cost=total / max(n, 1))

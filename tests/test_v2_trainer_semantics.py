@@ -1,0 +1,71 @@
+"""v2 trainer semantics: test() purity, fine-tune startup behavior,
+feed-slot resolution (regressions for review findings)."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def _linear_topology():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    return x, y, pred, cost
+
+
+def test_test_does_not_update_parameters():
+    paddle.init()
+    _, _, _, cost = _linear_topology()
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=0.1))
+    key = params.keys()[0]
+    before = params.get(key).copy()
+
+    rs = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(3):
+            yield [(rs.rand(4).astype("f"), rs.rand(1).astype("f"))
+                   for _ in range(5)]
+
+    res = trainer.test(reader=reader, feeding={"x": 0, "y": 1})
+    assert np.isfinite(res.cost)
+    np.testing.assert_allclose(params.get(key), before)
+
+
+def test_loaded_weights_survive_trainer_construction():
+    """Fine-tune flow: Parameters.set before SGD() must not be clobbered
+    by re-running parameter init ops (only new accumulators init)."""
+    paddle.init()
+    _, _, _, cost = _linear_topology()
+    params = paddle.parameters.create(cost)
+    k = params.keys()[0]
+    loaded = np.full(params.get(k).shape, 7.0, np.float32)
+    params.set(k, loaded)
+
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+    np.testing.assert_allclose(params.get(k), 7.0)
+
+    def reader():
+        yield [(np.ones(4, "f"), np.ones(1, "f")) for _ in range(4)]
+
+    trainer.train(reader=reader, num_passes=1)
+    assert not np.allclose(params.get(k), 7.0)
+
+
+def test_infer_rejects_wrong_feed_width():
+    paddle.init()
+    _, _, pred, cost = _linear_topology()
+    paddle.parameters.create(cost)
+    import pytest
+
+    with pytest.raises(ValueError):
+        paddle.infer(output_layer=pred,
+                     input=[(np.ones(4, "f"), np.ones(1, "f"))],
+                     feeding={"x": 0, "y": 1})
