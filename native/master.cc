@@ -77,10 +77,14 @@ class Master {
     for (auto &kv : pending_) pending_all.push_back(kv.second.first);
     dump(pending_all);  // leased tasks go back to todo on recover
     dump(done_);
+    dump(discarded_);
     w.i64(next_id_);
+    w.u32(dataset_set_ ? 1 : 0);
     FILE *f = fopen(path, "wb");
     if (!f) return -1;
+    uint32_t crc = crc32(w.buf.data(), w.buf.size());
     uint64_t n = w.buf.size();
+    fwrite(&crc, 4, 1, f);
     fwrite(&n, 8, 1, f);
     fwrite(w.buf.data(), 1, n, f);
     fclose(f);
@@ -90,11 +94,17 @@ class Master {
   int recover(const char *path) {
     FILE *f = fopen(path, "rb");
     if (!f) return -1;
+    uint32_t crc = 0;
     uint64_t n = 0;
-    if (fread(&n, 8, 1, f) != 1) { fclose(f); return -2; }
+    if (fread(&crc, 4, 1, f) != 1 || fread(&n, 8, 1, f) != 1 ||
+        n > (1ull << 32)) {
+      fclose(f);
+      return -2;
+    }
     std::vector<uint8_t> buf(n);
     if (fread(buf.data(), 1, n, f) != n) { fclose(f); return -2; }
     fclose(f);
+    if (crc32(buf.data(), n) != crc) return -3;  // corrupted snapshot
     std::lock_guard<std::mutex> g(mu_);
     Reader r(buf.data(), n);
     auto slurp = [&r](std::vector<Task> *ts) {
@@ -111,7 +121,9 @@ class Master {
     };
     slurp(&todo_);
     slurp(&done_);
+    slurp(&discarded_);
     next_id_ = r.i64();
+    dataset_set_ = r.u32() != 0;
     pending_.clear();
     return 0;
   }
@@ -151,17 +163,6 @@ class Master {
     } else {
       todo_.push_back(std::move(t));
     }
-    maybeRotatePassLocked();
-  }
-
-  // when a pass drains (no todo, no leases) recycle finished tasks so
-  // the next pass re-serves the dataset (reference master rotates
-  // passes over the same dataset)
-  void maybeRotatePassLocked() {
-    if (todo_.empty() && pending_.empty() && !done_.empty()) {
-      todo_ = std::move(done_);
-      done_.clear();
-    }
   }
 
   void handle(uint32_t op, Reader &r, Writer &w) {
@@ -192,8 +193,15 @@ class Master {
       case kGetTask: {
         std::lock_guard<std::mutex> g(mu_);
         if (todo_.empty()) {
-          bool all_done = pending_.empty() && dataset_set_;
-          w.u32(all_done ? 2u : 1u);  // 2: pass finished, 1: retry later
+          bool pass_done = pending_.empty() && dataset_set_;
+          if (pass_done && !done_.empty()) {
+            // report pass end once, then recycle finished tasks so the
+            // next get_task starts a fresh pass (reference: go/master
+            // rotates todo/done queues between passes)
+            todo_ = std::move(done_);
+            done_.clear();
+          }
+          w.u32(pass_done ? 2u : 1u);  // 2: pass finished, 1: retry
           return;
         }
         Task t = todo_.front();
@@ -218,7 +226,6 @@ class Master {
           done_.push_back(std::move(it->second.first));
           pending_.erase(it);
         }
-        maybeRotatePassLocked();
         w.u32(0);
         break;
       }
@@ -295,7 +302,9 @@ int ptrt_mclient_set_dataset(void *c, const char *const *chunks, int n,
 int64_t ptrt_mclient_get_task(void *c, char *buf, int64_t buflen) {
   Writer w;
   std::vector<uint8_t> resp;
-  if (!static_cast<Client *>(c)->call(kGetTask, w, &resp)) return -1;
+  // -3: transport failure (distinct from -1 retry-later so callers can
+  // tell a dead master from an empty queue)
+  if (!static_cast<Client *>(c)->call(kGetTask, w, &resp)) return -3;
   Reader r(resp.data(), resp.size());
   uint32_t rc = r.u32();
   if (rc == 1) return -1;
@@ -303,9 +312,13 @@ int64_t ptrt_mclient_get_task(void *c, char *buf, int64_t buflen) {
   int64_t id = r.i64();
   std::string chunks = r.str();
   if (buf && buflen > 0) {
-    size_t n = std::min(static_cast<size_t>(buflen - 1), chunks.size());
-    memcpy(buf, chunks.data(), n);
-    buf[n] = 0;
+    if (chunks.size() > static_cast<size_t>(buflen - 1)) {
+      // truncation would hand the worker a broken chunk path; surface
+      // an explicit error instead
+      return -4;
+    }
+    memcpy(buf, chunks.data(), chunks.size());
+    buf[chunks.size()] = 0;
   }
   return id;
 }

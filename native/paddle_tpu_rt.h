@@ -64,7 +64,10 @@ void ptrt_mclient_close(void *c);
 int ptrt_mclient_set_dataset(void *c, const char *const *chunks, int n,
                              int chunks_per_task);
 /* returns task id >=0 and fills buf with '\n'-joined chunk names;
- * -1: no task available; -2: all done */
+ * -1: no task available (all leased, retry later); -2: pass finished
+ * (reported once per pass, then the queue recycles for the next pass);
+ * -3: transport failure (master unreachable); -4: buf too small for the
+ * chunk list (task stays leased; retry with a bigger buffer) */
 int64_t ptrt_mclient_get_task(void *c, char *buf, int64_t buflen);
 int ptrt_mclient_task_finished(void *c, int64_t task_id);
 int ptrt_mclient_task_failed(void *c, int64_t task_id);

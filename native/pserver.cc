@@ -40,24 +40,6 @@ struct ParamEntry {
   Optimizer opt;
 };
 
-uint32_t crc32(const void *data, size_t n) {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
-    for (uint32_t i = 0; i < 256; ++i) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; ++k)
-        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      table[i] = c;
-    }
-    init = true;
-  }
-  uint32_t c = 0xFFFFFFFFu;
-  const uint8_t *p = static_cast<const uint8_t *>(data);
-  for (size_t i = 0; i < n; ++i) c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
-
 class PServer {
  public:
   PServer(int port, int num_trainers, int sync)
@@ -67,7 +49,17 @@ class PServer {
         }) {}
 
   int port() const { return server_.port(); }
-  void stop() { server_.stop(); }
+
+  void stop() {
+    {
+      // wake sync-barrier / gradient-round waiters so their connection
+      // threads can exit before Server::stop() joins them
+      std::lock_guard<std::mutex> g(mu_);
+      stopping_ = true;
+    }
+    cv_.notify_all();
+    server_.stop();
+  }
   int64_t numUpdates() {
     std::lock_guard<std::mutex> g(mu_);
     return updates_;
@@ -84,6 +76,14 @@ class PServer {
       w.bytes(kv.second.opt.m1.data(), kv.second.opt.m1.size() * 4);
       w.bytes(kv.second.opt.m2.data(), kv.second.opt.m2.size() * 4);
       w.i64(kv.second.opt.step);
+      // optimizer config: a restored server must keep optimizing the
+      // same way (reference: go/pserver checkpoint includes the
+      // serialized optimizer state+config)
+      w.u32(static_cast<uint32_t>(kv.second.opt.kind));
+      w.f64(kv.second.opt.lr);
+      w.f64(kv.second.opt.hp1);
+      w.f64(kv.second.opt.hp2);
+      w.f64(kv.second.opt.hp3);
     }
     uint32_t crc = crc32(w.buf.data(), w.buf.size());
     FILE *f = fopen(path, "wb");
@@ -127,6 +127,11 @@ class PServer {
       e.opt.m2.resize(len / 4);
       if (len) memcpy(e.opt.m2.data(), v, len);
       e.opt.step = r.i64();
+      e.opt.kind = static_cast<int>(r.u32());
+      e.opt.lr = r.f64();
+      e.opt.hp1 = r.f64();
+      e.opt.hp2 = r.f64();
+      e.opt.hp3 = r.f64();
     }
     return 0;
   }
@@ -190,7 +195,10 @@ class PServer {
             updates_++;
             cv_.notify_all();
           } else {
-            cv_.wait(g, [&] { return e.version > my_version; });
+            cv_.wait(g, [&] {
+              return e.version > my_version || stopping_;
+            });
+            if (stopping_) { w.u32(3); return; }
           }
         }
         w.u32(0);
@@ -222,6 +230,12 @@ class PServer {
         const int32_t *rows = reinterpret_cast<const int32_t *>(rowsb);
         const float *vals = reinterpret_cast<const float *>(valsb);
         size_t nrows = rlen / 4;
+        // bounds: the vals blob must actually hold nrows*width floats
+        if (width <= 0 ||
+            vlen < nrows * static_cast<uint64_t>(width) * 4) {
+          w.u32(2);
+          return;
+        }
         e.opt.step++;
         for (size_t i = 0; i < nrows; ++i) {
           size_t begin = static_cast<size_t>(rows[i]) * width;
@@ -232,7 +246,6 @@ class PServer {
         e.version++;
         updates_++;
         w.u32(0);
-        (void)vlen;
         break;
       }
       case kGetRows: {
@@ -267,7 +280,8 @@ class PServer {
           cv_.notify_all();
         } else {
           int64_t gen = barrier_gen_;
-          cv_.wait(g, [&] { return barrier_gen_ > gen; });
+          cv_.wait(g, [&] { return barrier_gen_ > gen || stopping_; });
+          if (stopping_) { w.u32(3); return; }
         }
         w.u32(0);
         break;
@@ -279,6 +293,7 @@ class PServer {
 
   int num_trainers_;
   int sync_;
+  bool stopping_ = false;
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, ParamEntry> params_;

@@ -13,27 +13,10 @@
 #include <vector>
 
 #include "paddle_tpu_rt.h"
+#include "transport.h"  // ptrt::crc32
 
 namespace ptrt {
 namespace {
-
-uint32_t crc32r(const void *data, size_t n) {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
-    for (uint32_t i = 0; i < 256; ++i) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; ++k)
-        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      table[i] = c;
-    }
-    init = true;
-  }
-  uint32_t c = 0xFFFFFFFFu;
-  const uint8_t *p = static_cast<const uint8_t *>(data);
-  for (size_t i = 0; i < n; ++i) c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
 
 // ---- buddy allocator ----------------------------------------------------
 
@@ -122,7 +105,7 @@ void *ptrt_recordio_writer_open(const char *path) {
 }
 int ptrt_recordio_write(void *w, const void *data, int64_t n) {
   FILE *f = static_cast<FILE *>(w);
-  uint32_t crc = crc32r(data, static_cast<size_t>(n));
+  uint32_t crc = crc32(data, static_cast<size_t>(n));
   uint32_t len = static_cast<uint32_t>(n);
   if (fwrite(&crc, 4, 1, f) != 1) return -1;
   if (fwrite(&len, 4, 1, f) != 1) return -1;
@@ -145,7 +128,7 @@ int64_t ptrt_recordio_read(void *r, void *buf, int64_t buflen) {
   if (fread(&len, 4, 1, f) != 1) return -2;
   if (len > static_cast<uint64_t>(buflen)) return -2;
   if (len && fread(buf, 1, len, f) != len) return -2;
-  if (crc32r(buf, len) != crc) return -2;
+  if (crc32(buf, len) != crc) return -2;
   return static_cast<int64_t>(len);
 }
 void ptrt_recordio_reader_close(void *r) { fclose(static_cast<FILE *>(r)); }

@@ -13,6 +13,29 @@
 
 namespace ptrt {
 
+namespace {
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+}  // namespace
+
+uint32_t crc32(const void *data, size_t n) {
+  static const Crc32Table table;  // thread-safe init (magic static)
+  uint32_t c = 0xFFFFFFFFu;
+  const uint8_t *p = static_cast<const uint8_t *>(data);
+  for (size_t i = 0; i < n; ++i)
+    c = table.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 static bool writeAll(int fd, const void *p, size_t n) {
   const char *b = static_cast<const char *>(p);
   while (n > 0) {
@@ -61,7 +84,10 @@ Server::Server(int port, Handler handler) : handler_(std::move(handler)) {
   setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  // all interfaces: pserver/master serve cross-host DCN traffic
+  // (reference: the pservers bind routable addresses; trainers discover
+  // them by host:port)
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(listen_fd_, reinterpret_cast<sockaddr *>(&addr),
              sizeof(addr)) != 0 ||
@@ -87,14 +113,27 @@ void Server::stop() {
     listen_fd_ = -1;
   }
   if (accept_thread_.joinable()) accept_thread_.join();
+  std::map<int, std::thread> remaining;
   {
     // unblock connection threads stuck in read() on live clients
     std::lock_guard<std::mutex> g(conn_mu_);
-    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    reapFinishedLocked();
+    for (auto &kv : conns_) ::shutdown(kv.first, SHUT_RDWR);
+    remaining.swap(conns_);
   }
-  for (auto &t : conns_)
-    if (t.joinable()) t.join();
-  conns_.clear();
+  for (auto &kv : remaining)
+    if (kv.second.joinable()) kv.second.join();
+}
+
+void Server::reapFinishedLocked() {
+  for (int fd : finished_fds_) {
+    auto it = conns_.find(fd);
+    if (it != conns_.end()) {
+      if (it->second.joinable()) it->second.join();
+      conns_.erase(it);
+    }
+  }
+  finished_fds_.clear();
 }
 
 void Server::acceptLoop() {
@@ -103,11 +142,9 @@ void Server::acceptLoop() {
     if (fd < 0) break;
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    {
-      std::lock_guard<std::mutex> g(conn_mu_);
-      conn_fds_.push_back(fd);
-    }
-    conns_.emplace_back([this, fd] { serveConn(fd); });
+    std::lock_guard<std::mutex> g(conn_mu_);
+    reapFinishedLocked();  // bound dead-thread growth on busy servers
+    conns_.emplace(fd, std::thread([this, fd] { serveConn(fd); }));
   }
 }
 
@@ -121,13 +158,10 @@ void Server::serveConn(int fd) {
     if (!sendFrame(fd, opcode, w.buf.data(), w.buf.size())) break;
   }
   {
+    // mark finished BEFORE close: the fd number can be reused by a new
+    // accept the moment it closes, and the reaper must find this entry
     std::lock_guard<std::mutex> g(conn_mu_);
-    for (size_t i = 0; i < conn_fds_.size(); ++i) {
-      if (conn_fds_[i] == fd) {
-        conn_fds_.erase(conn_fds_.begin() + i);
-        break;
-      }
-    }
+    finished_fds_.push_back(fd);
   }
   ::close(fd);
 }

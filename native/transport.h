@@ -12,12 +12,16 @@
 #include <cstdint>
 #include <cstring>
 #include <functional>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
 
 namespace ptrt {
+
+// CRC-32 (IEEE, table-driven); table init is thread-safe (magic static)
+uint32_t crc32(const void *data, size_t n);
 
 // binary reader/writer over a byte vector
 struct Writer {
@@ -86,14 +90,15 @@ class Server {
  private:
   void acceptLoop();
   void serveConn(int fd);
+  void reapFinishedLocked();
   int listen_fd_ = -1;
   int port_ = 0;
   Handler handler_;
   std::atomic<bool> stopping_{false};
   std::thread accept_thread_;
-  std::vector<std::thread> conns_;
   std::mutex conn_mu_;
-  std::vector<int> conn_fds_;  // live connection fds, for stop()
+  std::map<int, std::thread> conns_;  // fd -> serving thread
+  std::vector<int> finished_fds_;     // done threads awaiting join/reap
 };
 
 class Client {

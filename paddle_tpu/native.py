@@ -32,8 +32,13 @@ _lib = None
 
 
 def _build():
-    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                   stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "native runtime build failed (make -C %s):\n%s"
+            % (_NATIVE_DIR, proc.stdout.decode(errors="replace")))
 
 
 def lib():
@@ -252,9 +257,15 @@ class MasterClient:
 
     def get_task(self):
         """Returns (task_id, [chunk paths]); task_id is NO_TASK/-1 when
-        tasks are leased out, PASS_FINISHED/-2 when the pass is done."""
+        tasks are all leased out, PASS_FINISHED/-2 exactly once when a
+        pass drains (the queue then recycles for the next pass).
+        Raises ConnectionError if the master is unreachable."""
         buf = ctypes.create_string_buffer(1 << 20)
         tid = lib().ptrt_mclient_get_task(self._h, buf, len(buf))
+        if tid == -3:
+            raise ConnectionError("master unreachable")
+        if tid == -4:
+            raise ValueError("task chunk list exceeds client buffer")
         if tid < 0:
             return tid, []
         chunks = buf.value.decode().split("\n") if buf.value else []

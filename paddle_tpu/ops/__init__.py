@@ -27,3 +27,4 @@ from . import crf           # noqa: F401
 from . import ctc           # noqa: F401
 from . import beam          # noqa: F401
 from . import detection     # noqa: F401
+from . import dist          # noqa: F401

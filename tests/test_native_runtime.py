@@ -174,11 +174,14 @@ def test_master_task_queue(tmp_path):
         c.task_failed(t1)
         t1b, chunks1b = c.get_task()
         assert t1b == t1 and chunks1b == ["c2", "c3"]
-        # fail again -> discarded (failure_max=2); pass rotates with
-        # only the finished task
+        # fail again -> discarded (failure_max=2); the pass is now
+        # drained: PASS_FINISHED reported once, then the finished task
+        # recycles for the next pass
         c.task_failed(t1b)
-        t3, chunks3 = c.get_task()
-        assert t3 >= 0
+        t3, _ = c.get_task()
+        assert t3 == native.MasterClient.PASS_FINISHED
+        t4, chunks4 = c.get_task()
+        assert t4 >= 0 and chunks4 == ["c0", "c1"]
         c.close()
     finally:
         m.stop()
@@ -223,6 +226,91 @@ def test_master_snapshot_recover(tmp_path):
         c2.close()
     finally:
         m2.stop()
+
+
+def test_pserver_stop_unblocks_sync_waiter():
+    """stop() must wake a trainer blocked on the sync barrier (e.g. its
+    peer died) instead of deadlocking the join."""
+    import time
+
+    s = native.ParameterServer(num_trainers=2, sync=True)
+    c = native.PServerClient("127.0.0.1", s.port)
+    c.init_param("w", np.zeros(2, np.float32), opt_kind=native.OPT_SGD,
+                 lr=1.0)
+    err = {}
+
+    def lone_trainer():
+        try:
+            c.send_grad("w", np.ones(2, np.float32))  # blocks: no peer
+        except RuntimeError as e:
+            err["e"] = e
+
+    t = threading.Thread(target=lone_trainer)
+    t.start()
+    time.sleep(0.3)
+    s.stop()  # must not deadlock
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert "e" in err  # waiter surfaced the shutdown, not a fake update
+    c.close()
+
+
+def test_pserver_checkpoint_preserves_optimizer_config(tmp_path):
+    """A restored server must keep the same optimizer kind/lr, not fall
+    back to default SGD."""
+    path = str(tmp_path / "ckpt.bin")
+    s = native.ParameterServer(num_trainers=1, sync=True)
+    c = native.PServerClient("127.0.0.1", s.port)
+    c.init_param("w", np.zeros(2, np.float32),
+                 opt_kind=native.OPT_MOMENTUM, lr=0.5, hp1=0.9)
+    g = np.ones(2, np.float32)
+    c.send_grad("w", g)          # v=1, w=-0.5
+    assert s.save(path) == 0
+    c.close(); s.stop()
+
+    s2 = native.ParameterServer(num_trainers=1, sync=True)
+    try:
+        assert s2.load(path) == 0
+        c2 = native.PServerClient("127.0.0.1", s2.port)
+        got = c2.send_grad("w", g)   # v=0.9+1=1.9, w=-0.5-0.95=-1.45
+        np.testing.assert_allclose(got, -1.45, rtol=1e-6)
+        c2.close()
+    finally:
+        s2.stop()
+
+
+def test_master_recover_keeps_dataset_guard(tmp_path):
+    """recover() restores dataset_set_, so a post-recovery set_dataset
+    does not duplicate the dataset."""
+    path = str(tmp_path / "m.snap")
+    m = native.Master(timeout_ms=5000, failure_max=3)
+    c = native.MasterClient("127.0.0.1", m.port)
+    c.set_dataset(["x"], chunks_per_task=1)
+    assert m.snapshot(path) == 0
+    c.close(); m.stop()
+
+    m2 = native.Master(timeout_ms=5000, failure_max=3)
+    try:
+        assert m2.recover(path) == 0
+        c2 = native.MasterClient("127.0.0.1", m2.port)
+        c2.set_dataset(["x"], chunks_per_task=1)  # must be a no-op
+        t0, _ = c2.get_task()
+        assert t0 >= 0
+        t1, _ = c2.get_task()
+        assert t1 == native.MasterClient.NO_TASK  # no duplicate task
+        c2.close()
+    finally:
+        m2.stop()
+
+
+def test_master_client_dead_master_raises():
+    m = native.Master(timeout_ms=5000, failure_max=3)
+    c = native.MasterClient("127.0.0.1", m.port)
+    m.stop()
+    with pytest.raises(ConnectionError):
+        for _ in range(3):  # first call may drain a buffered response
+            c.get_task()
+    c.close()
 
 
 def test_recordio_roundtrip(tmp_path):
