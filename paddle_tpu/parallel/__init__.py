@@ -19,8 +19,10 @@ from .mesh import make_mesh, MeshConfig
 from .sharding import (param_spec, batch_spec, shard_state, shard_feeds,
                        replicated)
 from .trainer import ParallelTrainer, make_parallel_step
+from .ring import ring_attention, ulysses_attention, sp_shard_map
 
 __all__ = [
     "make_mesh", "MeshConfig", "param_spec", "batch_spec", "shard_state",
     "shard_feeds", "replicated", "ParallelTrainer", "make_parallel_step",
+    "ring_attention", "ulysses_attention", "sp_shard_map",
 ]
