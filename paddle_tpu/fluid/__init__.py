@@ -22,6 +22,8 @@ from . import regularizer
 from . import clip
 from . import io
 from . import evaluator
+from . import memory_optimization_transpiler
+from .memory_optimization_transpiler import memory_optimize
 from . import profiler
 from .data_feeder import DataFeeder
 from .param_attr import ParamAttr

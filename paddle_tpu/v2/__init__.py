@@ -19,6 +19,7 @@ from . import layer
 from . import networks
 from . import optimizer
 from . import parameters
+from . import plot
 from . import pooling
 from . import trainer
 

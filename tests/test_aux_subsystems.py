@@ -1,0 +1,59 @@
+"""Aux subsystems: flags from env, eager per-op profiler attribution,
+check_nan_inf (reference: utils/Flags.cpp, platform/profiler.h,
+executor.cc:29 FLAGS_check_nan_inf)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.utils import flags
+
+
+def _tiny_program():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3, act="relu")
+    out = fluid.layers.mean(x=h)
+    return x, out
+
+
+def test_flags_env_bootstrap(monkeypatch):
+    monkeypatch.setenv("FLAGS_check_nan_inf", "true")
+    flags.parse_flags_from_env()
+    assert flags.get_flag("check_nan_inf") is True
+    flags.set_flag("check_nan_inf", False)
+    assert flags.get_flag("check_nan_inf") is False
+
+
+def test_eager_profiler_per_op_table(capsys):
+    x, out = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), np.float32)}
+    with fluid.profiler.profiler(sorted_key="calls"):
+        exe.run(fluid.default_main_program(), feed=feed,
+                fetch_list=[out], eager=True)
+    printed = capsys.readouterr().out
+    # per-op rows appear (mul/elementwise_add from fc, relu, mean)
+    assert "Event" in printed
+    records = fluid.profiler.get_profile_records()
+    assert any("mul" in k or "matmul" in k for k in records), records
+    assert any("mean" in k for k in records), records
+
+
+def test_check_nan_inf_flag():
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    y = fluid.layers.log(x)  # log(-1) -> nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bad = {"x": np.array([[-1.0, 1.0]], np.float32)}
+    # without the flag: nan flows through silently
+    out, = exe.run(fluid.default_main_program(), feed=bad,
+                   fetch_list=[y], eager=True)
+    assert np.isnan(np.asarray(out)).any()
+    flags.set_flag("check_nan_inf", True)
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(fluid.default_main_program(), feed=bad,
+                    fetch_list=[y], eager=True)
+    finally:
+        flags.set_flag("check_nan_inf", False)

@@ -1,0 +1,84 @@
+"""C inference API: save a model, then load + infer from a real C
+program linked against libpaddle_tpu_capi.so (reference test analog:
+paddle/capi/examples/model_inference)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+C_SRC = r'''
+#include <stdio.h>
+#include <stdint.h>
+
+extern void *ptcapi_create(const char *model_dir);
+extern int64_t ptcapi_run(void *h, const float *in, const int64_t *dims,
+                          int ndims, float *out, int64_t cap,
+                          int64_t *out_dims, int *out_ndims);
+extern void ptcapi_destroy(void *h);
+
+int main(int argc, char **argv) {
+  void *h = ptcapi_create(argv[1]);
+  if (!h) { fprintf(stderr, "create failed\n"); return 2; }
+  float in[8];
+  for (int i = 0; i < 8; ++i) in[i] = (float)i / 8.0f;
+  int64_t dims[2] = {2, 4};
+  float out[64];
+  int64_t out_dims[8];
+  int out_nd = 0;
+  int64_t n = ptcapi_run(h, in, dims, 2, out, 64, out_dims, &out_nd);
+  if (n < 0) { fprintf(stderr, "run failed\n"); return 3; }
+  printf("n=%lld nd=%d", (long long)n, out_nd);
+  for (int i = 0; i < out_nd; ++i)
+    printf(" d%d=%lld", i, (long long)out_dims[i]);
+  for (int i = 0; i < (n < 6 ? n : 6); ++i) printf(" v%d=%.6f", i, out[i]);
+  printf("\n");
+  ptcapi_destroy(h);
+  return 0;
+}
+'''
+
+
+def test_c_program_infers_saved_model(tmp_path):
+    # 1) build + save a tiny model with known weights
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3, act=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe)
+
+    inp = (np.arange(8, dtype=np.float32) / 8.0).reshape(2, 4)
+    expect, = exe.run(fluid.io.load_inference_model(model_dir, exe)[0],
+                      feed={"x": inp}, fetch_list=[y.name])
+
+    # 2) compile the C consumer
+    src = tmp_path / "use_capi.c"
+    src.write_text(C_SRC)
+    exe_path = str(tmp_path / "use_capi")
+    subprocess.run(
+        ["gcc", str(src), "-o", exe_path,
+         "-L" + NATIVE_DIR, "-lpaddle_tpu_capi",
+         "-Wl,-rpath," + NATIVE_DIR],
+        check=True)
+
+    # 3) run it against the saved model
+    env = {**os.environ,
+           "PYTHONPATH": os.path.dirname(NATIVE_DIR),
+           "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([exe_path, model_dir], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    fields = dict(kv.split("=") for kv in out.stdout.split())
+    assert fields["n"] == "6" and fields["nd"] == "2"
+    assert fields["d0"] == "2" and fields["d1"] == "3"
+    got = [float(fields["v%d" % i]) for i in range(6)]
+    np.testing.assert_allclose(got, np.asarray(expect).reshape(-1),
+                               rtol=1e-5)

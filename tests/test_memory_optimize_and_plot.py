@@ -1,0 +1,43 @@
+"""memory_optimize pass interface + v2 Ploter (reference:
+memory_optimization_transpiler.py, v2/plot/plot.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle_v2
+
+
+def test_memory_optimize_liveness():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h1 = fluid.layers.fc(input=x, size=8, act="relu")
+    h2 = fluid.layers.fc(input=h1, size=8, act="relu")
+    out = fluid.layers.mean(x=h2)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(out)
+
+    released = fluid.memory_optimize(fluid.default_main_program())
+    all_released = {n for names in released.values() for n in names}
+    # intermediate activations die; parameters never released
+    assert any("tmp" in n or "@" in n for n in all_released), all_released
+    params = [v.name for v in
+              fluid.default_main_program().global_block().vars.values()
+              if isinstance(v, fluid.Parameter)]
+    assert not (set(params) & all_released)
+    # the analysis result is consistent with actually running the program
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    loss, = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[out])
+    assert np.isfinite(loss).all()
+
+
+def test_v2_ploter(capsys):
+    ploter = paddle_v2.plot.Ploter("train", "test")
+    ploter.append("train", 0, 1.0)
+    ploter.append("train", 1, 0.5)
+    ploter.append("test", 0, 0.9)
+    ploter.__disable_plot__ = True  # text mode for CI determinism
+    ploter.plot()
+    out = capsys.readouterr().out
+    assert "train" in out and "test" in out
+    ploter.reset()
+    assert ploter.__plot_data__["train"].step == []
