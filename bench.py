@@ -31,6 +31,11 @@ def main():
     from paddle_tpu.jit import FunctionalProgram, state_from_scope
     from __graft_entry__ import _build_resnet50
 
+    # bf16 MXU compute with f32 master weights is the TPU-native
+    # training dtype (BENCH_AMP=0 for pure f32)
+    if os.environ.get("BENCH_AMP", "1") != "0":
+        fluid.amp.enable_bf16()
+
     main_prog, startup, logits, avg_loss = _build_resnet50(
         batch, image_size, 1000, with_loss=True)
 

@@ -22,6 +22,7 @@ from . import regularizer
 from . import clip
 from . import io
 from . import evaluator
+from . import amp
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize
 from . import profiler

@@ -1,0 +1,38 @@
+"""Automatic mixed precision: bf16 compute, f32 master weights.
+
+TPU-native counterpart of the reference's float16 support (reference:
+paddle/math/float16.h — CUDA half/ARM fp16 interop; fp16 design docs).
+On TPU the native fast dtype is bfloat16: when enabled, the heavy MXU
+ops (mul/matmul/conv/lstm projections) cast their f32 operands to bf16
+and accumulate in f32 (`preferred_element_type`), while parameters,
+optimizer state, and all other ops stay f32 — master-weight semantics
+without loss scaling (bf16 keeps f32's exponent range).
+"""
+
+import contextlib
+
+from ..utils import flags
+
+__all__ = ["enable_bf16", "disable_bf16", "bf16_enabled", "bf16_guard"]
+
+
+def enable_bf16():
+    flags.set_flag("amp_bf16", True)
+
+
+def disable_bf16():
+    flags.set_flag("amp_bf16", False)
+
+
+def bf16_enabled():
+    return flags.get_flag("amp_bf16")
+
+
+@contextlib.contextmanager
+def bf16_guard():
+    prev = bf16_enabled()
+    flags.set_flag("amp_bf16", True)
+    try:
+        yield
+    finally:
+        flags.set_flag("amp_bf16", prev)

@@ -451,8 +451,11 @@ class Executor:
         for name, val in feed.items():
             feed_env[name] = self._prepare_feed(block0, name, val)
 
+        # dtype policy is trace-time state: a flipped amp flag must not
+        # reuse executables traced under the old policy
         key = (id(program), program.version, 0,
-               tuple(sorted(feed_env.keys())), tuple(fetch_names))
+               tuple(sorted(feed_env.keys())), tuple(fetch_names),
+               flags.get_flag("amp_bf16"))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = _CompiledProgram(self, program, 0,

@@ -24,7 +24,7 @@ __all__ = [
     "linear_chain_crf", "crf_decoding", "lrn", "conv2d_transpose",
     "dynamic_lstm", "dynamic_gru", "gru_unit", "sequence_softmax",
     "sequence_slice", "lod_reset", "edit_distance", "ctc_greedy_decoder",
-    "sequence_concat",
+    "sequence_concat", "beam_search", "beam_search_decode",
 ]
 
 
@@ -127,6 +127,50 @@ def sequence_softmax(x=None, input=None, **kwargs):
     helper.append_op(type="sequence_softmax", inputs={"X": [x]},
                      outputs={"Out": [out]})
     return out
+
+
+def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0,
+                **kwargs):
+    """Per-source top-k beam step (reference: layers/nn.py:1578
+    beam_search over beam_search_op.cc)."""
+    helper = LayerHelper("beam_search", **kwargs)
+    selected_ids = helper.create_tmp_variable(dtype="int64",
+                                              stop_gradient=True,
+                                              lod_level=2)
+    selected_scores = helper.create_tmp_variable(dtype="float32",
+                                                 stop_gradient=True,
+                                                 lod_level=2)
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "ids": [ids], "scores": [scores]},
+        outputs={"selected_ids": [selected_ids],
+                 "selected_scores": [selected_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level},
+        infer_shape=False)
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, **kwargs):
+    """Backtrack per-step beam selections into full hypotheses
+    (reference: beam_search_decode_op.cc).  ids/scores: TensorArray-like
+    lists of the per-step selected ids/scores."""
+    helper = LayerHelper("beam_search_decode", **kwargs)
+    sentence_ids = helper.create_tmp_variable(dtype="int64",
+                                              stop_gradient=True,
+                                              lod_level=2)
+    sentence_scores = helper.create_tmp_variable(dtype="float32",
+                                                 stop_gradient=True,
+                                                 lod_level=2)
+    ids_list = list(ids) if isinstance(ids, (list, tuple)) else [ids]
+    scores_list = (list(scores) if isinstance(scores, (list, tuple))
+                   else [scores])
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": ids_list, "Scores": scores_list},
+        outputs={"SentenceIds": [sentence_ids],
+                 "SentenceScores": [sentence_scores]},
+        infer_shape=False)
+    return sentence_ids, sentence_scores
 
 
 def sequence_concat(input, axis=0, **kwargs):

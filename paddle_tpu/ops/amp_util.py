@@ -1,0 +1,30 @@
+"""MXU dtype policy helper for heavy-op kernels (see fluid/amp.py)."""
+
+import jax.numpy as jnp
+
+from ..utils import flags
+
+__all__ = ["mxu_operands", "acc_kwargs", "ACC_DTYPE"]
+
+ACC_DTYPE = jnp.float32
+
+
+def acc_kwargs(*arrays):
+    """preferred_element_type kwargs for a matmul/conv over `arrays`:
+    force f32 accumulation only for bf16/f32 operands — integer and
+    f64 matmuls keep their native exact accumulation."""
+    if all(hasattr(a, "dtype") and
+           a.dtype in (jnp.bfloat16, jnp.float32) for a in arrays):
+        return {"preferred_element_type": ACC_DTYPE}
+    return {}
+
+
+def mxu_operands(*arrays):
+    """Under FLAGS_amp_bf16, cast f32 matmul/conv operands to bf16 (the
+    MXU's fast dtype); accumulation stays f32 via
+    preferred_element_type at the call site."""
+    if not flags.get_flag("amp_bf16"):
+        return arrays
+    return tuple(a.astype(jnp.bfloat16)
+                 if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                 for a in arrays)

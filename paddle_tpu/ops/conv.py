@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
+from .amp_util import mxu_operands, acc_kwargs
 from ..core.ragged import RaggedTensor
 
 
@@ -27,12 +28,14 @@ def conv2d(ctx, ins, attrs):
     paddings = tuple(attrs.get("paddings", [0, 0]))
     dilations = tuple(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1) or 1)
+    xm, wm = mxu_operands(x, w)
     out = lax.conv_general_dilated(
-        x, w, window_strides=strides,
+        xm, wm, window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    return {"Output": [out]}
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        **acc_kwargs(xm, wm))
+    return {"Output": [out.astype(x.dtype)]}
 
 
 @register_op("conv3d")
@@ -43,12 +46,14 @@ def conv3d(ctx, ins, attrs):
     paddings = tuple(attrs.get("paddings", [0, 0, 0]))
     dilations = tuple(attrs.get("dilations", [1, 1, 1]))
     groups = int(attrs.get("groups", 1) or 1)
+    xm, wm = mxu_operands(x, w)
     out = lax.conv_general_dilated(
-        x, w, window_strides=strides,
+        xm, wm, window_strides=strides,
         padding=[(p, p) for p in paddings],
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
-    return {"Output": [out]}
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        **acc_kwargs(xm, wm))
+    return {"Output": [out.astype(x.dtype)]}
 
 
 @register_op("conv2d_transpose")
@@ -64,15 +69,17 @@ def conv2d_transpose(ctx, ins, attrs):
     # conv backward-data path)
     kh = (w.shape[2] - 1) * dilations[0] + 1
     kw = (w.shape[3] - 1) * dilations[1] + 1
+    xm, wm = mxu_operands(x, jnp.flip(jnp.swapaxes(w, 0, 1), (2, 3)))
     out = lax.conv_general_dilated(
-        x, jnp.flip(jnp.swapaxes(w, 0, 1), (2, 3)),
+        xm, wm,
         window_strides=(1, 1),
         padding=[(kh - 1 - paddings[0], kh - 1 - paddings[0]),
                  (kw - 1 - paddings[1], kw - 1 - paddings[1])],
         lhs_dilation=strides,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    return {"Output": [out]}
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        **acc_kwargs(xm, wm))
+    return {"Output": [out.astype(x.dtype)]}
 
 
 def _pool2d_impl(x, attrs):

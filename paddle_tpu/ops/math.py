@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op
+from .amp_util import mxu_operands, acc_kwargs
 from ..core.ragged import RaggedTensor
 
 
@@ -41,7 +42,9 @@ def mul(ctx, ins, attrs):
     yn = int(attrs.get("y_num_col_dims", 1))
     x2 = _flatten2d(x, xn)
     y2 = _flatten2d(y, yn)
-    out = jnp.dot(x2, y2)
+    dtype = jnp.result_type(x.dtype, y.dtype)
+    x2, y2 = mxu_operands(x2, y2)
+    out = jnp.dot(x2, y2, **acc_kwargs(x2, y2)).astype(dtype)
     out_shape = x.shape[:xn] + y.shape[yn:]
     out = jnp.reshape(out, out_shape)
     xin = ins["X"][0]
@@ -57,7 +60,10 @@ def matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if attrs.get("transpose_Y"):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    return {"Out": [jnp.matmul(x, y)]}
+    dtype = jnp.result_type(x.dtype, y.dtype)
+    xm, ym = mxu_operands(x, y)
+    out = jnp.matmul(xm, ym, **acc_kwargs(xm, ym))
+    return {"Out": [out.astype(dtype)]}
 
 
 # -- elementwise family ------------------------------------------------------

@@ -20,7 +20,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
+from .amp_util import mxu_operands, acc_kwargs
 from ..core.ragged import RaggedTensor
+
+
+def _amp_dot(a, b):
+    """Recurrent projection matmul with the MXU dtype policy (bf16
+    operands + f32 accumulation under FLAGS_amp_bf16)."""
+    dtype = jnp.result_type(a.dtype, b.dtype)
+    am, bm = mxu_operands(a, b)
+    return jnp.dot(am, bm, **acc_kwargs(am, bm)).astype(dtype)
 
 
 def _seg_pos(rt, level=-1):
@@ -322,7 +331,7 @@ def lstm(ctx, ins, attrs):
     def step(carry, inp):
         h_prev, c_prev = carry
         x_t, m = inp
-        gates = x_t + jnp.dot(h_prev, w)
+        gates = x_t + _amp_dot(h_prev, w)
         if bias_g is not None:
             gates = gates + bias_g[None, :]
         gi = gates[:, :D]
@@ -391,9 +400,9 @@ def gru(ctx, ins, attrs):
 
     def step(h_prev, inp):
         x_t, m = inp
-        ur = act_g(x_t[:, :2 * D] + jnp.dot(h_prev, w_ur))
+        ur = act_g(x_t[:, :2 * D] + _amp_dot(h_prev, w_ur))
         u, r = ur[:, :D], ur[:, D:]
-        c = act_c(x_t[:, 2 * D:] + jnp.dot(r * h_prev, w_c))
+        c = act_c(x_t[:, 2 * D:] + _amp_dot(r * h_prev, w_c))
         h = u * h_prev + (1 - u) * c
         m1 = m[:, None]
         h = m1 * h + (1 - m1) * h_prev
@@ -424,9 +433,9 @@ def gru_unit(ctx, ins, attrs):
     D = h_prev.shape[1]
     if b is not None:
         x = x + jnp.reshape(b, (1, -1))
-    ur = act_g(x[:, :2 * D] + jnp.dot(h_prev, w[:, :2 * D]))
+    ur = act_g(x[:, :2 * D] + _amp_dot(h_prev, w[:, :2 * D]))
     u, r = ur[:, :D], ur[:, D:]
-    c = act_c(x[:, 2 * D:] + jnp.dot(r * h_prev, w[:, 2 * D:]))
+    c = act_c(x[:, 2 * D:] + _amp_dot(r * h_prev, w[:, 2 * D:]))
     h = u * h_prev + (1 - u) * c
     gate = jnp.concatenate([u, r, c], axis=1)
     return {"Gate": [gate], "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}

@@ -1,4 +1,7 @@
-"""Reader decorators (reference: python/paddle/v2/reader/)."""
+"""Reader decorators + creators (reference: python/paddle/v2/reader/)."""
 
 from .decorator import *  # noqa: F401,F403
-from .decorator import __all__  # noqa: F401
+from .decorator import __all__ as _dec_all
+from . import creator  # noqa: F401
+
+__all__ = list(_dec_all) + ["creator"]

@@ -1,0 +1,91 @@
+"""trainer_config_helpers — the original v2 config DSL surface.
+
+reference: python/paddle/trainer_config_helpers/layers.py (7.5k LoC of
+`*_layer` functions), activations.py, poolings.py, attrs.py,
+optimizers.py, networks.py.  Here every `*_layer` name maps onto the
+one TPU-native stack via paddle_tpu.v2.layer — same call signatures for
+the common arguments, one implementation underneath.
+"""
+
+from ..v2 import activation as _act
+from ..v2 import attr as _attr
+from ..v2 import layer as _layer
+from ..v2 import networks as _networks
+from ..v2 import pooling as _pooling
+from ..v2.data_type import (dense_vector, integer_value,  # noqa: F401
+                            integer_value_sequence, dense_vector_sequence)
+
+# activations (reference: trainer_config_helpers/activations.py)
+TanhActivation = _act.Tanh
+SigmoidActivation = _act.Sigmoid
+SoftmaxActivation = _act.Softmax
+IdentityActivation = _act.Identity
+LinearActivation = _act.Linear
+ReluActivation = _act.Relu
+BReluActivation = _act.BRelu
+SoftReluActivation = _act.SoftRelu
+STanhActivation = _act.STanh
+AbsActivation = _act.Abs
+SquareActivation = _act.Square
+ExpActivation = _act.Exp
+LogActivation = _act.Log
+
+# poolings (reference: trainer_config_helpers/poolings.py)
+MaxPooling = _pooling.Max
+AvgPooling = _pooling.Avg
+SumPooling = _pooling.Sum
+SqrtNPooling = _pooling.SquareRootN
+
+# attrs (reference: trainer_config_helpers/attrs.py)
+ParamAttr = _attr.Param
+ParameterAttribute = _attr.Param
+ExtraAttr = _attr.Extra
+ExtraLayerAttribute = _attr.Extra
+
+# layers (reference: trainer_config_helpers/layers.py *_layer funcs)
+data_layer = _layer.data
+fc_layer = _layer.fc
+embedding_layer = _layer.embedding
+img_conv_layer = _layer.img_conv
+img_pool_layer = _layer.img_pool
+batch_norm_layer = _layer.batch_norm
+lstmemory = _layer.lstmemory
+grumemory = _layer.grumemory
+pooling_layer = _layer.pool
+first_seq = _layer.first_seq
+last_seq = _layer.last_seq
+concat_layer = _layer.concat
+seq_concat_layer = _layer.seq_concat
+dropout_layer = _layer.dropout
+addto_layer = _layer.addto
+classification_cost = _layer.classification_cost
+cross_entropy = _layer.cross_entropy_cost
+cross_entropy_cost = _layer.cross_entropy_cost
+regression_cost = _layer.regression_cost
+square_error_cost = _layer.square_error_cost
+mse_cost = _layer.mse_cost
+crf_layer = _layer.crf
+crf_decoding_layer = _layer.crf_decoding
+maxid_layer = _layer.max_id
+expand_layer = _layer.expand
+cos_sim = _layer.cos_sim
+scaling_layer = _layer.scaling
+slope_intercept_layer = _layer.slope_intercept
+sum_cost = _layer.sum_cost
+trans_layer = _layer.trans
+mixed_layer = _layer.mixed
+full_matrix_projection = _layer.full_matrix_projection
+identity_projection = _layer.identity_projection
+table_projection = _layer.table_projection
+dotmul_projection = _layer.dotmul_projection
+context_projection = _layer.context_projection
+
+# networks (reference: trainer_config_helpers/networks.py)
+simple_img_conv_pool = _networks.simple_img_conv_pool
+img_conv_group = _networks.img_conv_group
+sequence_conv_pool = _networks.sequence_conv_pool
+simple_lstm = _networks.simple_lstm
+bidirectional_lstm = _networks.bidirectional_lstm
+simple_gru = _networks.simple_gru
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -63,5 +63,8 @@ DEFINE_flag("do_memory_benchmark", False,
             "log per-segment buffer sizes (reference: executor.cc:130)")
 DEFINE_flag("use_debug_nans", False,
             "enable jax debug_nans for compiled segments")
+DEFINE_flag("amp_bf16", False,
+            "cast MXU op operands (mul/matmul/conv) to bfloat16 with "
+            "f32 accumulation (see fluid.amp)")
 
 parse_flags_from_env()

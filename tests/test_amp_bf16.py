@@ -1,0 +1,68 @@
+"""bf16 mixed precision: MXU ops compute in bf16 with f32 master
+weights (reference fp16 analog: paddle/math/float16.h)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _train(steps=8):
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=32, act="relu")
+    logits = fluid.layers.fc(input=h, size=4)
+    cost = fluid.layers.mean(
+        x=fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                  label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(32, 16).astype(np.float32)
+    ys = (xs[:, :1] > 0).astype(np.int64)
+    losses = []
+    for _ in range(steps):
+        out, = exe.run(fluid.default_main_program(),
+                       feed={"x": xs, "y": ys}, fetch_list=[cost])
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+    return losses
+
+
+def test_bf16_training_converges_and_params_stay_f32():
+    with fluid.amp.bf16_guard():
+        assert fluid.amp.bf16_enabled()
+        losses = _train()
+    assert losses[-1] < losses[0], losses
+    # master weights stayed f32
+    from paddle_tpu.core import scope as scope_mod
+
+    block = fluid.default_main_program().global_block()
+    for var in block.vars.values():
+        if isinstance(var, fluid.Parameter):
+            val = scope_mod.global_scope().get(var.name)
+            assert np.asarray(val).dtype == np.float32
+    assert not fluid.amp.bf16_enabled()
+
+
+def test_bf16_toggle_invalidates_cached_executable():
+    """Same program, flag flipped between runs: results must reflect
+    the new policy (cache key includes the flag)."""
+    x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+    y = fluid.layers.fc(input=x, size=64, bias_attr=False,
+                        param_attr=fluid.ParamAttr(
+                            initializer=fluid.initializer.Constant(
+                                1.0 + 2.0 ** -10)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.full((1, 64), 1.0 + 2.0 ** -10, np.float32)}
+    f32_out, = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[y])
+    with fluid.amp.bf16_guard():
+        bf16_out, = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[y])
+    # 1+2^-10 is not representable in bf16 -> results differ
+    assert not np.allclose(f32_out, bf16_out)
+    again, = exe.run(fluid.default_main_program(), feed=feed,
+                     fetch_list=[y])
+    np.testing.assert_allclose(again, f32_out)

@@ -1,0 +1,70 @@
+"""Cluster launcher: spawn pservers + trainers as real processes and
+train distributed fit_a_line through the full role protocol
+(reference: paddle/scripts/cluster_train launcher behavior)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.tools.cluster_launch import launch
+
+TRAIN_SCRIPT = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import DistributeTranspiler
+    from paddle_tpu.ops.dist import ClientPool
+
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    yp = fluid.layers.fc(input=x, size=1)
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    avg = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=yp, label=y))
+    oops, pg = fluid.optimizer.SGD(learning_rate=0.01).minimize(avg)
+    t = DistributeTranspiler()
+    t.transpile(optimize_ops=oops, params_grads=pg,
+                trainer_id=int(os.environ["TRAINER_ID"]),
+                pservers=os.environ["PSERVERS"],
+                trainers=int(os.environ["TRAINERS"]))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    t.init_pservers()
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x, y])
+    rd = paddle.batch(paddle.dataset.uci_housing.train(), batch_size=20)
+    losses = []
+    for p in range(3):
+        for d in rd():
+            out, = exe.run(fluid.default_main_program(),
+                           feed=feeder.feed(d), fetch_list=[avg])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+    ClientPool.reset()
+    sys.exit(0 if losses[-1] < losses[0] else 1)
+""")
+
+
+def test_cluster_launch_end_to_end(tmp_path):
+    script = tmp_path / "train_dist.py"
+    script.write_text(TRAIN_SCRIPT)
+    ports = []
+    for _ in range(2):
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            ports.append(sk.getsockname()[1])
+    pservers = ["127.0.0.1:%d" % p for p in ports]
+
+    ps_procs, tr_procs = launch(
+        [str(script)], pservers, trainers=2, sync=True,
+        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu"})
+    try:
+        rcs = [p.wait(timeout=240) for p in tr_procs]
+        assert rcs == [0, 0], rcs
+    finally:
+        import signal
+
+        for p in ps_procs:
+            p.send_signal(signal.SIGTERM)
+        for p in ps_procs:
+            p.wait(timeout=30)
