@@ -1,0 +1,46 @@
+"""LoDRankTable: sequences sorted by length, descending.
+
+reference: paddle/framework/lod_rank_table.h — the DynamicRNN machinery
+sorts sequences longest-first so each timestep's active batch is a
+prefix; these tables are host metadata (the reference computes them on
+CPU too).
+"""
+
+import numpy as np
+
+__all__ = ["LoDRankTable"]
+
+
+class LoDRankTable:
+    """items: list of (original_seq_index, length), sorted by length
+    descending (stable)."""
+
+    def __init__(self, items):
+        self.items = list(items)
+
+    @staticmethod
+    def from_lengths(lengths):
+        lengths = np.asarray(lengths).reshape(-1)
+        order = sorted(range(len(lengths)),
+                       key=lambda i: (-int(lengths[i]), i))
+        return LoDRankTable([(i, int(lengths[i])) for i in order])
+
+    def indices(self):
+        return [i for i, _ in self.items]
+
+    def lengths(self):
+        return [n for _, n in self.items]
+
+    def max_len(self):
+        return self.items[0][1] if self.items else 0
+
+    def active_at(self, step):
+        """How many sequences are still running at `step` (prefix size,
+        reference: shrink_rnn_memory semantics)."""
+        return sum(1 for _, n in self.items if n > step)
+
+    def __len__(self):
+        return len(self.items)
+
+    def __repr__(self):
+        return "LoDRankTable(%r)" % (self.items,)

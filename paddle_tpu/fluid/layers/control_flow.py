@@ -20,7 +20,10 @@ from ...core.types import VarType
 __all__ = [
     "While", "StaticRNN", "DynamicRNN", "ConditionalBlock", "less_than",
     "array_write", "array_read", "array_length", "create_array",
-    "max_sequence_len",
+    "max_sequence_len", "lod_rank_table", "lod_tensor_to_array",
+    "array_to_lod_tensor", "shrink_memory", "reorder_lod_tensor_by_rank",
+    "split_lod_tensor", "merge_lod_tensor", "Print", "IfElse",
+    "ParallelDo",
 ]
 
 
@@ -493,3 +496,198 @@ def _dense_to_sequence(helper, x, like):
         type="dense_to_sequence", inputs={"X": [x], "Like": [like]},
         outputs={"Out": [out]})
     return out
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table layer plumbing (reference: control_flow.py
+# lod_rank_table:790s, lod_tensor_to_array, array_to_lod_tensor,
+# shrink_memory, reorder_lod_tensor_by_rank; ops in
+# ops/control_flow.py keep host semantics like the reference's CPU-only
+# kernels)
+# ---------------------------------------------------------------------------
+
+def lod_rank_table(x, level=0, **kwargs):
+    helper = LayerHelper("lod_rank_table", **kwargs)
+    table = helper.create_variable(
+        name=unique_name("lod_rank_table.tmp"), dtype="int32",
+        type=VarType.RAW, stop_gradient=True)
+    helper.append_op(type="lod_rank_table", inputs={"X": [x]},
+                     outputs={"Out": [table]},
+                     attrs={"level": level}, infer_shape=False)
+    return table
+
+
+def lod_tensor_to_array(x, table, **kwargs):
+    helper = LayerHelper("lod_tensor_to_array", **kwargs)
+    array = helper.create_variable(
+        name=unique_name("lod_tensor_to_array.tmp"), dtype=x.dtype,
+        type=VarType.TENSOR_ARRAY, stop_gradient=True)
+    helper.append_op(type="lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [array]}, infer_shape=False)
+    return array
+
+
+def array_to_lod_tensor(x, table, **kwargs):
+    helper = LayerHelper("array_to_lod_tensor", **kwargs)
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op(type="array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def shrink_memory(x, i, table, **kwargs):
+    helper = LayerHelper("shrink_memory", **kwargs)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, **kwargs):
+    helper = LayerHelper("reorder_lod_tensor_by_rank", **kwargs)
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op(type="reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def split_lod_tensor(input, mask, level=0, **kwargs):
+    helper = LayerHelper("split_lod_tensor", **kwargs)
+    out_true = helper.create_tmp_variable(dtype=input.dtype,
+                                          lod_level=input.lod_level)
+    out_false = helper.create_tmp_variable(dtype=input.dtype,
+                                           lod_level=input.lod_level)
+    helper.append_op(type="split_lod_tensor",
+                     inputs={"X": [input], "Mask": [mask]},
+                     outputs={"OutTrue": [out_true],
+                              "OutFalse": [out_false]},
+                     attrs={"level": level}, infer_shape=False)
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0, **kwargs):
+    helper = LayerHelper("merge_lod_tensor", **kwargs)
+    out = helper.create_tmp_variable(dtype=in_true.dtype,
+                                     lod_level=x.lod_level)
+    helper.append_op(type="merge_lod_tensor",
+                     inputs={"X": [x], "Mask": [mask],
+                             "InTrue": [in_true], "InFalse": [in_false]},
+                     outputs={"Out": [out]},
+                     attrs={"level": level}, infer_shape=False)
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both", **kwargs):
+    """reference: the print operator (print_op.cc) — debug-print a
+    tensor as it flows; forwards its input unchanged."""
+    helper = LayerHelper("print", **kwargs)
+    out = helper.create_tmp_variable(dtype=input.dtype,
+                                     lod_level=input.lod_level)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"first_n": first_n,
+                            "message": message or "",
+                            "summarize": summarize,
+                            "print_tensor_name": print_tensor_name,
+                            "print_phase": print_phase},
+                     infer_shape=False)
+    return out
+
+
+class IfElse:
+    """Row-routed two-branch execution (reference: control_flow.py
+    IfElse:~900 over split_lod_tensor / conditional blocks /
+    merge_lod_tensor): rows where cond holds flow through the
+    true_block, the rest through the false_block, outputs merge back in
+    input order."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.status = self.OUT_IF_ELSE_BLOCKS
+        self._true_inputs = {}
+        self._false_inputs = {}
+        self._true_outputs = []
+        self._false_outputs = []
+
+    def input(self, x):
+        if self.status == self.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside a block")
+        true_part, false_part = split_lod_tensor(x, self.cond)
+        self._true_inputs[x.name] = true_part
+        self._false_inputs[x.name] = false_part
+        return (true_part if self.status == self.IN_IF_ELSE_TRUE_BLOCKS
+                else false_part)
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self.status = self.IN_IF_ELSE_TRUE_BLOCKS
+        yield
+        self.status = self.OUT_IF_ELSE_BLOCKS
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self.status = self.IN_IF_ELSE_FALSE_BLOCKS
+        yield
+        self.status = self.OUT_IF_ELSE_BLOCKS
+
+    def output(self, *outs):
+        if self.status == self.IN_IF_ELSE_TRUE_BLOCKS:
+            self._true_outputs.extend(outs)
+        elif self.status == self.IN_IF_ELSE_FALSE_BLOCKS:
+            self._false_outputs.extend(outs)
+        else:
+            raise ValueError("output() must be called inside a block")
+
+    def __call__(self):
+        if len(self._true_outputs) != len(self._false_outputs):
+            raise ValueError("true/false blocks must produce the same "
+                             "number of outputs")
+        merged = []
+        # any split input serves as the row-order template
+        template = next(iter(self._true_inputs))
+        prog_var = self.helper.main_program.current_block().var(template)
+        for t, f in zip(self._true_outputs, self._false_outputs):
+            merged.append(merge_lod_tensor(t, f, prog_var, self.cond))
+        return merged if len(merged) > 1 else merged[0]
+
+
+class ParallelDo:
+    """API-compat data-parallel block (reference: control_flow.py
+    ParallelDo:230 over parallel_do_op.cc — splits the batch across
+    places and averages gradients via NCCL).  On TPU, batch-splitting
+    is expressed declaratively: the whole program runs SPMD over a
+    Mesh (paddle_tpu.parallel.ParallelTrainer shards the batch over
+    the 'dp' axis and XLA inserts the gradient psum over ICI), so this
+    wrapper executes its block once on the global batch — numerically
+    identical to the reference's split-and-average."""
+
+    def __init__(self, places, name=None):
+        self.places = places
+        self._ins = []
+        self._outs = []
+
+    @contextlib.contextmanager
+    def do(self):
+        yield
+
+    def read_input(self, var):
+        self._ins.append(var)
+        return var
+
+    def write_output(self, var):
+        self._outs.append(var)
+
+    def __call__(self):
+        return self._outs if len(self._outs) != 1 else self._outs[0]

@@ -281,11 +281,13 @@ def lod_array_length(ctx, ins, attrs):
     return {"Out": [arr.length.reshape((1,)).astype(jnp.int64)]}
 
 
-@register_op("max_sequence_len", stop_gradient_op=True)
+@register_op("max_sequence_len", stop_gradient_op=True, jittable=False)
 def max_sequence_len(ctx, ins, attrs):
-    """reference: max_sequence_len_op.cc (max len from a rank table);
-    here: from a RaggedTensor's splits."""
+    """reference: max_sequence_len_op.cc — max length from a
+    LoDRankTable (host object) or directly from a RaggedTensor."""
     rt = ins["RankTable"][0]
+    if hasattr(rt, "max_len"):          # LoDRankTable
+        return {"Out": [jnp.asarray([rt.max_len()], jnp.int64)]}
     lens = rt.seq_lengths() if hasattr(rt, "seq_lengths") else rt
     return {"Out": [jnp.max(lens).reshape((1,)).astype(jnp.int64)]}
 
@@ -312,3 +314,179 @@ def get_places(ctx, ins, attrs):
 
 
 _gi("get_places").infer_shape = lambda block, od: None
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table machinery (the reference DynamicRNN plumbing:
+# lod_rank_table_op.cc, lod_tensor_to_array_op.cc,
+# array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+# reorder_lod_tensor_by_rank_op.cc, split_lod_tensor_op.cc,
+# merge_lod_tensor_op.cc).  Host ops — the reference computes all of
+# this on CPU as well; the scan-based DynamicRNN (fluid.layers) is the
+# compiled fast path.
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from ..core.ragged import RaggedTensor
+from ..core.rank_table import LoDRankTable
+
+
+def _lengths_of(x):
+    import numpy as _np
+
+    return _np.asarray(x.seq_lengths(0)).tolist()
+
+
+@register_op("lod_rank_table", stop_gradient_op=True, jittable=False)
+def lod_rank_table(ctx, ins, attrs):
+    """reference: lod_rank_table_op.cc — sort sequences by length desc.
+    Restricted to lod_level-1 inputs: the downstream kernels
+    (lod_tensor_to_array etc.) slice the deepest split level, which for
+    multi-level LoD would mix levels silently."""
+    x = ins["X"][0]
+    level = int(attrs.get("level", 0))
+    if x.lod_level != 1 or level != 0:
+        raise NotImplementedError(
+            "lod_rank_table supports lod_level-1 inputs at level 0 "
+            "(got lod_level=%d, level=%d)" % (x.lod_level, level))
+    return {"Out": [LoDRankTable.from_lengths(_lengths_of(x))]}
+
+
+@register_op("reorder_lod_tensor_by_rank", stop_gradient_op=True,
+             jittable=False)
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """reference: reorder_lod_tensor_by_rank_op.cc — permute X's
+    sequences into the rank table's order."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    vals = np.asarray(x.values)
+    splits = np.asarray(x.row_splits[-1])
+    out_rows, new_splits = [], [0]
+    for i in table.indices():
+        out_rows.append(vals[splits[i]:splits[i + 1]])
+        new_splits.append(new_splits[-1] + (splits[i + 1] - splits[i]))
+    out = np.concatenate(out_rows, 0) if out_rows else vals[:0]
+    return {"Out": [RaggedTensor(jnp.asarray(out),
+                                 [np.asarray(new_splits, np.int32)])]}
+
+
+@register_op("lod_tensor_to_array", stop_gradient_op=True, jittable=False)
+def lod_tensor_to_array(ctx, ins, attrs):
+    """reference: lod_tensor_to_array_op.cc — per-timestep dense slices
+    in rank-table order (step t holds the t-th element of every
+    sequence still active at t)."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    vals = np.asarray(x.values)
+    splits = np.asarray(x.row_splits[-1])
+    steps = []
+    for t in range(table.max_len()):
+        rows = [vals[splits[i] + t]
+                for i, n in table.items if n > t]
+        steps.append(jnp.asarray(np.stack(rows, 0)))
+    return {"Out": [steps]}
+
+
+@register_op("array_to_lod_tensor", stop_gradient_op=True, jittable=False)
+def array_to_lod_tensor(ctx, ins, attrs):
+    """reference: array_to_lod_tensor_op.cc — inverse of
+    lod_tensor_to_array."""
+    steps = ins["X"][0]
+    table = ins["RankTable"][0]
+    seqs = {i: [] for i, _ in table.items}
+    for t, arr in enumerate(steps):
+        arr = np.asarray(arr)
+        row = 0
+        for i, n in table.items:
+            if n > t:
+                seqs[i].append(arr[row])
+                row += 1
+    # output stays in rank-table order (the reference's RNN in/out
+    # convention: reorder_lod_tensor_by_rank restores original order)
+    out_rows, new_splits = [], [0]
+    for i, n in table.items:
+        out_rows.extend(seqs[i])
+        new_splits.append(new_splits[-1] + n)
+    out = np.stack(out_rows, 0)
+    return {"Out": [RaggedTensor(jnp.asarray(out),
+                                 [np.asarray(new_splits, np.int32)])]}
+
+
+@register_op("shrink_rnn_memory", jittable=False,
+             nondiff_inputs=("RankTable", "I"))
+def shrink_rnn_memory(ctx, ins, attrs):
+    """reference: shrink_rnn_memory_op.cc — keep the prefix of rows
+    still active at step I (X is a dense [B, ...] memory)."""
+    x = ins["X"][0]
+    if isinstance(x, RaggedTensor):
+        raise TypeError("shrink_rnn_memory expects a dense memory "
+                        "tensor, not a RaggedTensor")
+    x = np.asarray(x)
+    table = ins["RankTable"][0]
+    i = int(np.asarray(ins["I"][0]).reshape(-1)[0])
+    return {"Out": [jnp.asarray(x[:table.active_at(i)])]}
+
+
+@register_grad_kernel("shrink_rnn_memory")
+def shrink_rnn_memory_grad(ctx, ins, attrs):
+    """reference: ShrinkRNNMemoryGradOp — scatter dOut back into the
+    full-size memory, zero for rows past the active prefix."""
+    x = np.asarray(ins["X"][0])
+    d_out = np.asarray(ins["Out@GRAD"][0])
+    dx = np.zeros_like(x)
+    dx[:d_out.shape[0]] = d_out
+    return {"X@GRAD": [jnp.asarray(dx)]}
+
+
+@register_op("split_lod_tensor", stop_gradient_op=True, jittable=False)
+def split_lod_tensor(ctx, ins, attrs):
+    """reference: split_lod_tensor_op.cc — route rows by a bool mask
+    (IfElse input split)."""
+    x = ins["X"][0]
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    dense = not isinstance(x, RaggedTensor)
+    vals = np.asarray(x if dense else x.values)
+    out_true = vals[mask] if dense else None
+    out_false = vals[~mask] if dense else None
+    if dense:
+        return {"OutTrue": [jnp.asarray(out_true)],
+                "OutFalse": [jnp.asarray(out_false)]}
+    splits = np.asarray(x.row_splits[-1])
+    rows_t, st_t, rows_f, st_f = [], [0], [], [0]
+    for i in range(len(splits) - 1):
+        seg = vals[splits[i]:splits[i + 1]]
+        if mask[i]:
+            rows_t.append(seg)
+            st_t.append(st_t[-1] + len(seg))
+        else:
+            rows_f.append(seg)
+            st_f.append(st_f[-1] + len(seg))
+    cat = lambda rs: (np.concatenate(rs, 0) if rs else vals[:0])
+    return {
+        "OutTrue": [RaggedTensor(jnp.asarray(cat(rows_t)),
+                                 [np.asarray(st_t, np.int32)])],
+        "OutFalse": [RaggedTensor(jnp.asarray(cat(rows_f)),
+                                  [np.asarray(st_f, np.int32)])],
+    }
+
+
+@register_op("merge_lod_tensor", stop_gradient_op=True, jittable=False)
+def merge_lod_tensor(ctx, ins, attrs):
+    """reference: merge_lod_tensor_op.cc — inverse routing (IfElse
+    output merge)."""
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    in_true = np.asarray(ins["InTrue"][0])
+    in_false = np.asarray(ins["InFalse"][0])
+    width = in_true.shape[1:] if in_true.size else in_false.shape[1:]
+    out = np.zeros((len(mask),) + width,
+                   in_true.dtype if in_true.size else in_false.dtype)
+    out[mask] = in_true
+    out[~mask] = in_false
+    return {"Out": [jnp.asarray(out)]}
+
+
+for _t in ("lod_rank_table", "reorder_lod_tensor_by_rank",
+           "lod_tensor_to_array", "array_to_lod_tensor",
+           "shrink_rnn_memory", "split_lod_tensor", "merge_lod_tensor"):
+    _gi(_t).infer_shape = _array_infer_shape

@@ -1,0 +1,36 @@
+"""Dataset stand-ins expose the reference reader API with the right
+shapes/dtypes (reference: python/paddle/v2/dataset/tests)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_sentiment():
+    s = next(paddle.dataset.sentiment.train()())
+    words, label = s
+    assert all(isinstance(w, int) for w in words)
+    assert label in (0, 1)
+    assert len(paddle.dataset.sentiment.get_word_dict()) > 5000
+
+
+def test_wmt16():
+    src, trg_in, trg_next = next(paddle.dataset.wmt16.train(100, 100)())
+    assert trg_in[0] == paddle.dataset.wmt14.ID_MARK_START
+    assert trg_next[-1] == paddle.dataset.wmt14.ID_MARK_END
+    assert len(trg_in) == len(trg_next)
+
+
+def test_mq2007_pairwise_and_listwise():
+    lab, f1, f2 = next(paddle.dataset.mq2007.train("pairwise")())
+    assert f1.shape == (46,) and f2.shape == (46,)
+    feats, rel = next(paddle.dataset.mq2007.train("listwise")())
+    assert feats.shape[1] == 46 and rel.shape[0] == feats.shape[0]
+
+
+def test_flowers_and_voc():
+    im, lab = next(paddle.dataset.flowers.train()())
+    assert im.shape == (3, 224, 224) and 0 <= lab < 102
+    im, seg = next(paddle.dataset.voc2012.train()())
+    assert im.shape[0] == 3 and seg.shape == im.shape[1:]
+    assert seg.max() < paddle.dataset.voc2012.CLASS_NUM

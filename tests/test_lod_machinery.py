@@ -1,0 +1,97 @@
+"""LoD rank-table machinery: rank table, tensor<->array conversion,
+shrink_memory, reorder, split/merge + IfElse (reference:
+lod_rank_table_op, lod_tensor_to_array_op, shrink_rnn_memory_op,
+split_lod_tensor_op / merge_lod_tensor_op tests)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _feed_seq(place, name_to_seqs, feed_vars):
+    feeder = fluid.DataFeeder(place=place, feed_list=feed_vars)
+    n = len(next(iter(name_to_seqs.values())))
+    rows = [tuple(name_to_seqs[v.name][i] for v in feed_vars)
+            for i in range(n)]
+    return feeder.feed(rows)
+
+
+def test_rank_table_array_roundtrip():
+    x = layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+    table = layers.lod_rank_table(x)
+    arr = layers.lod_tensor_to_array(x, table)
+    back = layers.array_to_lod_tensor(arr, table)
+    reordered = layers.reorder_lod_tensor_by_rank(x, table)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    seqs = [[[1, 1]],                       # len 1
+            [[2, 2], [3, 3], [4, 4]],      # len 3
+            [[5, 5], [6, 6]]]              # len 2
+    feed = _feed_seq(place, {"x": seqs}, [x])
+    out_back, out_reord = exe.run(
+        fluid.default_main_program(), feed=feed,
+        fetch_list=[back, reordered], return_numpy=False)
+
+    vals = np.asarray(out_back.values)[:int(out_back.nvalid)]
+    # rank order: seq1 (len3), seq2 (len2), seq0 (len1)
+    expect = [[2, 2], [3, 3], [4, 4], [5, 5], [6, 6], [1, 1]]
+    assert vals.tolist() == expect
+    assert out_back.lod() == [[0, 3, 5, 6]]
+
+    rvals = np.asarray(out_reord.values)[:int(out_reord.nvalid)]
+    assert rvals.tolist() == expect
+
+
+def test_shrink_memory():
+    from paddle_tpu.core.rank_table import LoDRankTable
+    from paddle_tpu.ops.registry import get_op_info
+
+    table = LoDRankTable.from_lengths([1, 3, 2])
+    kernel = get_op_info("shrink_rnn_memory").kernel
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = kernel(None, {"X": [x], "RankTable": [table],
+                        "I": [np.array([1])]}, {})
+    # active at step 1: lengths 3 and 2 -> prefix of 2 rows
+    assert np.asarray(out["Out"][0]).shape == (2, 4)
+    out0 = kernel(None, {"X": [x], "RankTable": [table],
+                         "I": [np.array([2])]}, {})
+    assert np.asarray(out0["Out"][0]).shape == (1, 4)
+
+
+def test_ifelse_row_routing():
+    """Rows with x < 0 negate, others pass through (reference IfElse
+    pattern)."""
+    x = layers.data(name="x", shape=[1], dtype="float32")
+    zero = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.less_than(x=x, y=zero)
+
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        xt = ie.input(x)
+        ie.output(fluid.layers.scale(x=xt, scale=-1.0))
+    with ie.false_block():
+        xf = ie.input(x)
+        ie.output(xf)
+    out = ie()
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    xs = np.array([[-1.0], [2.0], [-3.0], [4.0]], np.float32)
+    res, = exe.run(fluid.default_main_program(), feed={"x": xs},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res).reshape(-1),
+                               [1.0, 2.0, 3.0, 4.0])
+
+
+def test_print_layer_passthrough(capsys):
+    x = layers.data(name="x", shape=[2], dtype="float32")
+    y = layers.Print(x, message="dbg")
+    out = fluid.layers.mean(x=y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    res, = exe.run(fluid.default_main_program(),
+                   feed={"x": np.ones((2, 2), np.float32)},
+                   fetch_list=[out])
+    assert np.isclose(float(np.asarray(res).reshape(-1)[0]), 1.0)
