@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op
+from .registry import register_op, register_grad_kernel
 from ..core.tensor_array import TensorArray, EmptyTensorArray, \
     DEFAULT_CAPACITY
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Pre-commit smoke gate: never snapshot a red HEAD again.
+#   scripts/smoke.sh          -> import check + fast test subset (~1 min)
+#   scripts/smoke.sh --full   -> import check + full suite
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
+
+echo "[smoke] import paddle_tpu ..."
+python -c "import paddle_tpu; import __graft_entry__; print('  ok:', len(paddle_tpu.ops.registry._OP_REGISTRY), 'ops registered')"
+
+if [[ "${1:-}" == "--full" ]]; then
+  echo "[smoke] full test suite ..."
+  python -m pytest tests/ -x -q
+else
+  echo "[smoke] fast subset ..."
+  python -m pytest tests/test_math_ops.py tests/test_lod_machinery.py -x -q
+  python -m pytest tests/ -q --collect-only >/dev/null
+fi
+echo "[smoke] green"
