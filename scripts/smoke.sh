@@ -9,7 +9,7 @@ export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
 
 echo "[smoke] import paddle_tpu ..."
-python -c "import paddle_tpu; import __graft_entry__; print('  ok:', len(paddle_tpu.ops.registry._OP_REGISTRY), 'ops registered')"
+python -c "import paddle_tpu; import __graft_entry__; print('  ok:', len(paddle_tpu.ops.registry.registered_ops()), 'ops registered')"
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "[smoke] full test suite ..."
