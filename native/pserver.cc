@@ -258,6 +258,15 @@ class PServer {
         if (it == params_.end()) { w.u32(1); return; }
         const int32_t *rows = reinterpret_cast<const int32_t *>(rowsb);
         size_t nrows = rlen / 4;
+        // bounds: reject non-positive or absurd width before the
+        // allocation (mirrors the kSendSparseGrad check) so a bad
+        // request can't bad_alloc the server process. 1<<28 floats
+        // (1 GiB) is far above any real sparse fetch.
+        if (width <= 0 ||
+            nrows * static_cast<uint64_t>(width) > (1ull << 28)) {
+          w.u32(2);
+          return;
+        }
         std::vector<float> out(nrows * width, 0.f);
         for (size_t i = 0; i < nrows; ++i) {
           size_t begin = static_cast<size_t>(rows[i]) * width;

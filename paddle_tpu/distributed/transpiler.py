@@ -119,6 +119,46 @@ class DistributeTranspiler:
                 "adam; no config found for params %s" % unsupported)
         self.param_opt = configured
 
+        # pserver optimizer config snapshots the LR once at
+        # init_pservers; an LR-decay schedule writing the LR var in the
+        # trainer program would silently have no effect on updates
+        # (the reference ships the current LR with every update —
+        # ParameterServer2 trainingConfig). Surface that loudly.
+        lr_names = {lr for _k, lr, _hp in configured.values()}
+        written = {}
+        for op in block.ops:
+            if op in opt_ops:
+                continue
+            for outs in op.desc.outputs.values():
+                for o in outs:
+                    written.setdefault(o, []).append(op)
+        def _is_static_param_lr(op):
+            # Optimizer._create_param_lr emits a constant `scale` of the
+            # global LR for per-param learning_rate attrs; that's not a
+            # schedule — only warn when the writer's inputs are
+            # themselves produced by ops (step counters, in-place decay).
+            in_names = [i for ins in op.desc.inputs.values() for i in ins]
+            out_names = [o for outs in op.desc.outputs.values()
+                         for o in outs]
+            if any(o in in_names for o in out_names):
+                return False  # in-place update: evolves across steps
+            return (op.type == "scale" and not any(
+                any(w is not op for w in written.get(i, []))
+                for i in in_names))
+        decay_writers = [
+            op.type for name in lr_names for op in written.get(name, [])
+            if not _is_static_param_lr(op)]
+        if decay_writers:
+            import warnings
+
+            warnings.warn(
+                "DistributeTranspiler: ops %s write the learning-rate "
+                "var, but the pserver optimizer snapshots LR once at "
+                "init_pservers(); the decay schedule will NOT affect "
+                "pserver updates. Re-run init_pservers() to refresh, "
+                "or keep the optimizer local." % sorted(set(decay_writers)),
+                stacklevel=2)
+
         # sparse-grad params stay whole on one endpoint (rows route to a
         # single owner; reference sparse tables also shard by row
         # server-set, not by flat range)

@@ -41,6 +41,17 @@ def _build():
             % (_NATIVE_DIR, proc.stdout.decode(errors="replace")))
 
 
+def _stale():
+    """True when any native source/header is newer than the built .so
+    (binaries are not committed; make is cheap and a no-op when fresh)."""
+    so_mtime = os.path.getmtime(_SO_PATH)
+    for f in os.listdir(_NATIVE_DIR):
+        if (f.endswith((".cc", ".h")) or f == "Makefile") and \
+                os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > so_mtime:
+            return True
+    return False
+
+
 def lib():
     """Load (building if needed) the native runtime library."""
     global _lib
@@ -49,7 +60,7 @@ def lib():
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
+        if not os.path.exists(_SO_PATH) or _stale():
             _build()
         L = ctypes.CDLL(_SO_PATH)
         c = ctypes

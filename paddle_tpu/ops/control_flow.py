@@ -476,8 +476,32 @@ def merge_lod_tensor(ctx, ins, attrs):
     """reference: merge_lod_tensor_op.cc — inverse routing (IfElse
     output merge)."""
     mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
-    in_true = np.asarray(ins["InTrue"][0])
-    in_false = np.asarray(ins["InFalse"][0])
+    t_in, f_in = ins["InTrue"][0], ins["InFalse"][0]
+    if isinstance(t_in, RaggedTensor) or isinstance(f_in, RaggedTensor):
+        # interleave true/false sequences back into mask order,
+        # rebuilding row_splits (symmetric with split_lod_tensor).
+        def _segs(r):
+            if not isinstance(r, RaggedTensor):
+                v = np.asarray(r)
+                return [v[i:i + 1] for i in range(len(v))]
+            v, sp = np.asarray(r.values), np.asarray(r.row_splits[-1])
+            return [v[sp[i]:sp[i + 1]] for i in range(len(sp) - 1)]
+
+        seg_t, seg_f = iter(_segs(t_in)), iter(_segs(f_in))
+        segs, splits = [], [0]
+        for m in mask:
+            seg = next(seg_t) if m else next(seg_f)
+            segs.append(seg)
+            splits.append(splits[-1] + len(seg))
+        if segs:
+            vals = np.concatenate(segs, 0)
+        else:  # empty mask: keep the input's trailing dims/dtype
+            proto = t_in if isinstance(t_in, RaggedTensor) else f_in
+            vals = np.asarray(proto.values)[:0]
+        return {"Out": [RaggedTensor(jnp.asarray(vals),
+                                     [np.asarray(splits, np.int32)])]}
+    in_true = np.asarray(t_in)
+    in_false = np.asarray(f_in)
     width = in_true.shape[1:] if in_true.size else in_false.shape[1:]
     out = np.zeros((len(mask),) + width,
                    in_true.dtype if in_true.size else in_false.dtype)

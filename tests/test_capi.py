@@ -46,6 +46,14 @@ int main(int argc, char **argv) {
 '''
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _build_capi():
+    # binaries are not committed; make is a no-op when fresh
+    from paddle_tpu.native import _build
+
+    _build()
+
+
 def test_c_program_infers_saved_model(tmp_path):
     # 1) build + save a tiny model with known weights
     x = fluid.layers.data(name="x", shape=[4], dtype="float32")

@@ -207,3 +207,26 @@ def test_multiprocess_roles():
     ps.stdin.close()
     ps.wait(timeout=30)
     assert rcs == [0, 0], rcs
+
+
+def test_lr_decay_warning():
+    """An op writing the optimizer's LR var after transpile means the
+    pserver's snapshotted LR goes stale — transpile must warn."""
+    import warnings
+
+    x, y, avg_cost, optimize_ops, params_grads = _build_fit_a_line()
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    lr_name = optimize_ops[0].desc.input("LearningRate")[0]
+    # simulate an LR-decay schedule: an op whose output is the LR var
+    block.append_op(type="scale", inputs={"X": [block.var(lr_name)]},
+                    outputs={"Out": [block.var(lr_name)]},
+                    attrs={"scale": 0.9}, infer_shape=False)
+
+    t = DistributeTranspiler()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        t.transpile(optimize_ops=optimize_ops, params_grads=params_grads,
+                    pservers="127.0.0.1:6174", trainers=1)
+    assert any("learning-rate" in str(w.message) for w in rec), \
+        [str(w.message) for w in rec]

@@ -86,6 +86,31 @@ def test_ifelse_row_routing():
                                [1.0, 2.0, 3.0, 4.0])
 
 
+def test_split_merge_lod_roundtrip_ragged():
+    """split_lod_tensor -> merge_lod_tensor over a ragged (LoD) input
+    must reconstruct the original sequences in mask order (reference:
+    merge_lod_tensor_op.cc supports LoD outputs)."""
+    from paddle_tpu.core.ragged import RaggedTensor
+    from paddle_tpu.ops.registry import get_op_info
+
+    vals = np.arange(12, dtype=np.float32).reshape(6, 2)
+    x = RaggedTensor(vals, [np.array([0, 1, 4, 6], np.int32)])  # lens 1,3,2
+    mask = np.array([[1], [0], [1]], np.int32)
+
+    split = get_op_info("split_lod_tensor").kernel
+    merge = get_op_info("merge_lod_tensor").kernel
+    parts = split(None, {"X": [x], "Mask": [mask]}, {})
+    out_t, out_f = parts["OutTrue"][0], parts["OutFalse"][0]
+    assert np.asarray(out_t.row_splits[-1]).tolist() == [0, 1, 3]
+    assert np.asarray(out_f.row_splits[-1]).tolist() == [0, 3]
+
+    merged = merge(None, {"X": [x], "Mask": [mask], "InTrue": [out_t],
+                          "InFalse": [out_f]}, {})["Out"][0]
+    assert isinstance(merged, RaggedTensor)
+    np.testing.assert_allclose(np.asarray(merged.values), vals)
+    assert np.asarray(merged.row_splits[-1]).tolist() == [0, 1, 4, 6]
+
+
 def test_print_layer_passthrough(capsys):
     x = layers.data(name="x", shape=[2], dtype="float32")
     y = layers.Print(x, message="dbg")
