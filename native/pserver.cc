@@ -238,6 +238,8 @@ class PServer {
         }
         e.opt.step++;
         for (size_t i = 0; i < nrows; ++i) {
+          // negative ids would wrap the size_t multiply past the bound
+          if (rows[i] < 0) continue;
           size_t begin = static_cast<size_t>(rows[i]) * width;
           if (begin + width > e.value.size()) continue;
           e.opt.apply(e.value.data(), vals + i * width, begin,
@@ -269,6 +271,7 @@ class PServer {
         }
         std::vector<float> out(nrows * width, 0.f);
         for (size_t i = 0; i < nrows; ++i) {
+          if (rows[i] < 0) continue;
           size_t begin = static_cast<size_t>(rows[i]) * width;
           if (begin + width <= it->second.value.size())
             memcpy(out.data() + i * width,

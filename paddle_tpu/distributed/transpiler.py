@@ -132,22 +132,21 @@ class DistributeTranspiler:
             for outs in op.desc.outputs.values():
                 for o in outs:
                     written.setdefault(o, []).append(op)
-        def _is_static_param_lr(op):
-            # Optimizer._create_param_lr emits a constant `scale` of the
-            # global LR for per-param learning_rate attrs; that's not a
-            # schedule — only warn when the writer's inputs are
-            # themselves produced by ops (step counters, in-place decay).
+        def _is_static_lr_writer(op):
+            # Constant producers (fill_constant LR vars, the per-param
+            # `scale` that Optimizer._create_param_lr emits) yield the
+            # same value every step — not a schedule. Warn only when
+            # the writer updates one of its own inputs in place or its
+            # inputs are produced by other ops (step counters).
             in_names = [i for ins in op.desc.inputs.values() for i in ins]
             out_names = [o for outs in op.desc.outputs.values()
                          for o in outs]
             if any(o in in_names for o in out_names):
                 return False  # in-place update: evolves across steps
-            return (op.type == "scale" and not any(
-                any(w is not op for w in written.get(i, []))
-                for i in in_names))
+            return not any(written.get(i) for i in in_names)
         decay_writers = [
             op.type for name in lr_names for op in written.get(name, [])
-            if not _is_static_param_lr(op)]
+            if not _is_static_lr_writer(op)]
         if decay_writers:
             import warnings
 

@@ -487,7 +487,14 @@ def merge_lod_tensor(ctx, ins, attrs):
             v, sp = np.asarray(r.values), np.asarray(r.row_splits[-1])
             return [v[sp[i]:sp[i + 1]] for i in range(len(sp) - 1)]
 
-        seg_t, seg_f = iter(_segs(t_in)), iter(_segs(f_in))
+        segs_t, segs_f = _segs(t_in), _segs(f_in)
+        n_true = int(mask.sum())
+        if len(segs_t) != n_true or len(segs_f) != len(mask) - n_true:
+            raise ValueError(
+                "merge_lod_tensor: mask selects %d true / %d false rows "
+                "but InTrue has %d and InFalse has %d sequences"
+                % (n_true, len(mask) - n_true, len(segs_t), len(segs_f)))
+        seg_t, seg_f = iter(segs_t), iter(segs_f)
         segs, splits = [], [0]
         for m in mask:
             seg = next(seg_t) if m else next(seg_f)
