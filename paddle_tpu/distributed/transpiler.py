@@ -143,7 +143,17 @@ class DistributeTranspiler:
                          for o in outs]
             if any(o in in_names for o in out_names):
                 return False  # in-place update: evolves across steps
-            return not any(written.get(i) for i in in_names)
+
+            def _static_src(n):
+                # produced by no op AND persistable (a param/constant);
+                # a non-persistable producer-less var is a feed — dynamic
+                if written.get(n):
+                    return False
+                v = block.vars.get(n)
+                return v is not None and bool(
+                    getattr(v, "persistable", False))
+
+            return all(_static_src(i) for i in in_names)
         decay_writers = [
             op.type for name in lr_names for op in written.get(name, [])
             if not _is_static_lr_writer(op)]

@@ -23,7 +23,7 @@ __all__ = [
     "max_sequence_len", "lod_rank_table", "lod_tensor_to_array",
     "array_to_lod_tensor", "shrink_memory", "reorder_lod_tensor_by_rank",
     "split_lod_tensor", "merge_lod_tensor", "Print", "IfElse",
-    "ParallelDo",
+    "ParallelDo", "equal",
 ]
 
 
@@ -34,6 +34,17 @@ def less_than(x, y, cond=None, **kwargs):
         cond = helper.create_tmp_variable(dtype="bool")
         cond.stop_gradient = True
     helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def equal(x, y, cond=None, **kwargs):
+    """reference: control_flow.py equal, compare_op.cc."""
+    helper = LayerHelper("equal", **kwargs)
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(type="equal", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [cond]})
     return cond
 

@@ -25,6 +25,7 @@ __all__ = [
     "dynamic_lstm", "dynamic_gru", "gru_unit", "sequence_softmax",
     "sequence_slice", "lod_reset", "edit_distance", "ctc_greedy_decoder",
     "sequence_concat", "beam_search", "beam_search_decode",
+    "sequence_reverse",
 ]
 
 
@@ -751,6 +752,16 @@ def sequence_first_step(input, **kwargs):
 
 def sequence_last_step(input, **kwargs):
     return sequence_pool(input, "last", **kwargs)
+
+
+def sequence_reverse(x, **kwargs):
+    """Reverse each sequence's time order (reference: reversed inlinks of
+    RecurrentLayerGroup, api parity with later sequence_reverse op)."""
+    helper = LayerHelper("sequence_reverse", input=x, **kwargs)
+    out = helper.create_tmp_variable(x.dtype, lod_level=x.lod_level)
+    helper.append_op(type="sequence_reverse", inputs={"X": [x]},
+                     outputs={"Y": [out]})
+    return out
 
 
 def sequence_expand(x, y, **kwargs):

@@ -264,6 +264,24 @@ def sequence_slice(ctx, ins, attrs):
     return {"Out": [RaggedTensor(vals, [new_splits], nvalid)]}
 
 
+@register_op("sequence_reverse")
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse the rows within each sequence (reference:
+    RecurrentLayerGroup reversed inlinks; later sequence_reverse_op).
+    Gather through the mirrored in-sequence position — pure jax, same
+    splits out."""
+    x = ins["X"][0]
+    seg, inseq, valid = _seg_pos(x)
+    rs = x.last_splits()
+    lengths = rs[1:] - rs[:-1]
+    src = rs[seg] + lengths[seg] - 1 - inseq
+    src = jnp.clip(src, 0, x.values.shape[0] - 1)
+    vals = jnp.where(
+        valid.reshape((-1,) + (1,) * (x.values.ndim - 1)),
+        x.values[src], jnp.zeros_like(x.values))
+    return {"Y": [RaggedTensor(vals, x.row_splits, x.nvalid)]}
+
+
 @register_op("lod_reset")
 def lod_reset(ctx, ins, attrs):
     x = ins["X"][0]

@@ -9,6 +9,10 @@ trainer can map reader tuple slots without an explicit ``feeding``.
 from .. import fluid
 from ..fluid import layers as fl
 from . import activation as act_mod
+from .recurrent import (StaticInput, SubsequenceInput, GeneratedInput,
+                        memory, recurrent_group, beam_search,
+                        get_output_layer, eos_layer, maxid_layer,
+                        register_layer_output)
 
 __all__ = [
     "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
@@ -19,6 +23,10 @@ __all__ = [
     "scaling", "slope_intercept", "sum_cost", "trans", "mixed",
     "full_matrix_projection", "identity_projection", "table_projection",
     "dotmul_projection", "context_projection",
+    # recurrent surface
+    "StaticInput", "SubsequenceInput", "GeneratedInput", "memory",
+    "recurrent_group", "beam_search", "get_output_layer", "eos_layer",
+    "maxid_layer", "gru_step_layer", "lstm_step_layer", "recurrent",
 ]
 
 def _act_name(act):
@@ -69,44 +77,49 @@ def _reset_data_layers(program=None):
     del _program_data_layers(program)[:]
 
 
-def fc(input, size, act=None, param_attr=None, bias_attr=None, **kw):
-    return fl.fc(input=input, size=size, act=_act_name(act),
-                 param_attr=param_attr, bias_attr=bias_attr)
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
+       **kw):
+    out = fl.fc(input=input, size=size, act=_act_name(act),
+                param_attr=param_attr, bias_attr=bias_attr)
+    return register_layer_output(name, out)
 
 
-def embedding(input, size, param_attr=None, **kw):
+def embedding(input, size, param_attr=None, name=None, **kw):
     dim = input._v2_input_type.dim if hasattr(input, "_v2_input_type") \
         else kw.pop("vocab_size")
-    return fl.embedding(input=input, size=[dim, size],
-                        param_attr=param_attr)
+    return register_layer_output(
+        name, fl.embedding(input=input, size=[dim, size],
+                           param_attr=param_attr))
 
 
 def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
              padding=None, act=None, param_attr=None, bias_attr=None,
-             **kw):
+             name=None, **kw):
     if padding is None:
         padding = (filter_size - 1) // 2
-    return fl.conv2d(input=input, num_filters=num_filters,
-                     filter_size=filter_size, stride=stride,
-                     padding=padding, act=_act_name(act),
-                     param_attr=param_attr, bias_attr=bias_attr)
+    return register_layer_output(name, fl.conv2d(
+        input=input, num_filters=num_filters,
+        filter_size=filter_size, stride=stride,
+        padding=padding, act=_act_name(act),
+        param_attr=param_attr, bias_attr=bias_attr))
 
 
 def img_pool(input, pool_size, pool_type=None, stride=None, padding=0,
-             **kw):
+             name=None, **kw):
     from . import pooling
 
     if pool_type is None:
         pool_type = pooling.Max
-    name = pool_type.name if not isinstance(pool_type, str) else pool_type
-    name = {"average": "avg"}.get(name, name)
-    return fl.pool2d(input=input, pool_size=pool_size, pool_type=name,
-                     pool_stride=stride or pool_size,
-                     pool_padding=padding)
+    pt = pool_type.name if not isinstance(pool_type, str) else pool_type
+    pt = {"average": "avg"}.get(pt, pt)
+    return register_layer_output(name, fl.pool2d(
+        input=input, pool_size=pool_size, pool_type=pt,
+        pool_stride=stride or pool_size, pool_padding=padding))
 
 
-def batch_norm(input, act=None, **kw):
-    return fl.batch_norm(input=input, act=_act_name(act))
+def batch_norm(input, act=None, name=None, **kw):
+    return register_layer_output(
+        name, fl.batch_norm(input=input, act=_act_name(act)))
 
 
 def lstmemory(input, size=None, reverse=False, act=None, **kw):
@@ -118,58 +131,63 @@ def lstmemory(input, size=None, reverse=False, act=None, **kw):
     hidden, _ = fl.dynamic_lstm(
         input=input, size=size * 4, is_reverse=reverse,
         candidate_activation=_act_name(act) or "tanh")
-    return hidden
+    return register_layer_output(kw.get("name"), hidden)
 
 
 def grumemory(input, size=None, reverse=False, act=None, **kw):
     if size is None:
         size = input.shape[-1] // 3
-    return fl.dynamic_gru(input=input, size=size, is_reverse=reverse,
-                          candidate_activation=_act_name(act) or "tanh")
+    return register_layer_output(kw.get("name"), fl.dynamic_gru(
+        input=input, size=size, is_reverse=reverse,
+        candidate_activation=_act_name(act) or "tanh"))
 
 
-def pool(input, pooling_type=None, **kw):
+def pool(input, pooling_type=None, name=None, **kw):
     from . import pooling
 
     if pooling_type is None:
         pooling_type = pooling.Max
-    name = pooling_type.name if not isinstance(pooling_type, str) \
+    pt = pooling_type.name if not isinstance(pooling_type, str) \
         else pooling_type
-    return fl.sequence_pool(input=input, pool_type=name)
+    return register_layer_output(
+        name, fl.sequence_pool(input=input, pool_type=pt))
 
 
-def first_seq(input, **kw):
-    return fl.sequence_first_step(input=input)
+def first_seq(input, name=None, **kw):
+    return register_layer_output(name,
+                                 fl.sequence_first_step(input=input))
 
 
-def last_seq(input, **kw):
-    return fl.sequence_last_step(input=input)
+def last_seq(input, name=None, **kw):
+    return register_layer_output(name,
+                                 fl.sequence_last_step(input=input))
 
 
-def concat(input, act=None, **kw):
+def concat(input, act=None, name=None, **kw):
     out = fl.concat(input=input, axis=-1)
-    name = _act_name(act)
-    if name:
-        out = getattr(fl, name)(out)
-    return out
+    act_n = _act_name(act)
+    if act_n:
+        out = getattr(fl, act_n)(out)
+    return register_layer_output(name, out)
 
 
-def seq_concat(a, b, **kw):
-    return fl.sequence_concat(input=[a, b])
+def seq_concat(a, b, name=None, **kw):
+    return register_layer_output(name, fl.sequence_concat(input=[a, b]))
 
 
-def dropout(input, dropout_rate, **kw):
-    return fl.dropout(x=input, dropout_prob=dropout_rate)
+def dropout(input, dropout_rate, name=None, **kw):
+    return register_layer_output(
+        name, fl.dropout(x=input, dropout_prob=dropout_rate))
 
 
-def addto(input, act=None, bias_attr=None, **kw):
+def addto(input, act=None, bias_attr=None, name=None, **kw):
     if not isinstance(input, (list, tuple)):
         input = [input]
     out = fl.sums(input=list(input))
-    name = _act_name(act)
-    if name:
-        out = getattr(fl, name)(out)
-    return out
+    act_n = _act_name(act)
+    if act_n:
+        out = getattr(fl, act_n)(out)
+    return register_layer_output(name, out)
 
 
 def classification_cost(input, label, **kw):
@@ -287,12 +305,92 @@ def context_projection(input, context_len, context_start=None):
         filter_size=context_len, bias_attr=False))
 
 
-def mixed(size=None, input=None, act=None, bias_attr=None, **kw):
+def mixed(size=None, input=None, act=None, bias_attr=None, name=None,
+          **kw):
     outs = [p.build() if isinstance(p, _Projection) else p
             for p in (input if isinstance(input, (list, tuple))
                       else [input])]
     out = outs[0] if len(outs) == 1 else fl.sums(input=outs)
-    name = _act_name(act)
-    if name:
-        out = getattr(fl, name)(out)
-    return out
+    if bias_attr not in (None, False):
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("mixed_bias", bias_attr=bias_attr)
+        out = helper.append_bias_op(out)
+    act_n = _act_name(act)
+    if act_n:
+        out = getattr(fl, act_n)(out)
+    return register_layer_output(name, out)
+
+
+def gru_step_layer(input, output_mem, size=None, act=None,
+                   gate_act=None, name=None, param_attr=None,
+                   bias_attr=None, **kw):
+    """One GRU step: input is the [B, 3*size] projection, output_mem the
+    previous hidden state (reference: layers.py gru_step_layer over
+    GruStepLayer.cpp)."""
+    if size is None:
+        size = output_mem.shape[-1]
+    hidden, _, _ = fl.gru_unit(
+        input=input, hidden=output_mem, size=size * 3,
+        param_attr=param_attr, bias_attr=bias_attr,
+        activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid")
+    return register_layer_output(name, hidden)
+
+
+gru_step_naive_layer = gru_step_layer
+
+
+def lstm_step_layer(input, state, size=None, act=None, gate_act=None,
+                    state_act=None, name=None, bias_attr=None, **kw):
+    """One LSTM step: input is the [B, 4*size] gate projection, state
+    the previous cell (reference: layers.py lstm_step_layer over
+    LstmStepLayer.cpp: c' = sigma(f)*c + sigma(i)*act(z);
+    h = sigma(o)*state_act(c')).  The returned layer is the hidden
+    output; the new cell is reachable via
+    get_output_layer(..., arg_name='state')."""
+    from ..fluid.layer_helper import LayerHelper
+
+    if size is None:
+        size = state.shape[-1]
+    act_n = _act_name(act) or "tanh"
+    gate_n = _act_name(gate_act) or "sigmoid"
+    state_n = _act_name(state_act) or "tanh"
+
+    gates = input
+    if bias_attr not in (None, False):
+        helper = LayerHelper("lstm_step_bias", bias_attr=bias_attr)
+        gates = helper.append_bias_op(gates)
+    z, i, f, o = fl.split(gates, num_or_sections=4, dim=-1)
+    new_c = fl.elementwise_add(
+        x=fl.elementwise_mul(x=getattr(fl, gate_n)(f), y=state),
+        y=fl.elementwise_mul(x=getattr(fl, gate_n)(i),
+                             y=getattr(fl, act_n)(z)))
+    h = fl.elementwise_mul(x=getattr(fl, gate_n)(o),
+                           y=getattr(fl, state_n)(new_c))
+    h._v2_extra_outputs = {"state": new_c}
+    return register_layer_output(name, h)
+
+
+def recurrent(input, act=None, bias_attr=None, param_attr=None,
+              reverse=False, name=None, **kw):
+    """Simple fully-connected recurrence: out_t = act(in_t + W out_{t-1}
+    + b) — the input enters unprojected, one [size, size] recurrent
+    weight (reference: layers.py recurrent_layer over
+    RecurrentLayer.cpp)."""
+    size = input.shape[-1]
+
+    act_name = "tanh" if act is None else _act_name(act)
+
+    def _step(y):
+        mem = memory(name=None, size=size)
+        proj = fl.fc(input=mem, size=size, act=None,
+                     param_attr=param_attr, bias_attr=bias_attr)
+        out = fl.sums(input=[y, proj])
+        if act_name:
+            out = getattr(fl, act_name)(out)
+        mem.set_input(out)
+        return out
+
+    out = recurrent_group(_step, input, reverse=reverse)
+    return register_layer_output(name, out)

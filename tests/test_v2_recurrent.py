@@ -1,0 +1,278 @@
+"""v2 recurrent_group / memory / beam_search generation (reference:
+trainer_config_helpers/layers.py recurrent_group:4082, memory:3590,
+beam_search:4406; runtime RecurrentGradientMachine.h:32,307-309)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.v2 as v2
+import paddle_tpu.fluid as fluid
+
+layer = v2.layer
+
+
+def _run_seq(out, feeds, lod_feeds):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    blk = fluid.default_main_program().global_block()
+    feeder = fluid.DataFeeder(
+        place=fluid.CPUPlace(), feed_list=[blk.var(n) for n in feeds])
+    rows = [tuple(lod_feeds[n][i] for n in feeds)
+            for i in range(len(lod_feeds[feeds[0]]))]
+    res, = exe.run(fluid.default_main_program(), feed=feeder.feed(rows),
+                   fetch_list=[out], return_numpy=False)
+    if hasattr(res, "values"):
+        return np.asarray(res.values)[:int(res.nvalid)], res.lod()
+    return np.asarray(res), None
+
+
+def test_recurrent_group_accumulator():
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sequence(3))
+
+    def step(y):
+        mem = layer.memory(name="acc", size=3)
+        out = layer.addto(input=[mem, y], act=None)
+        mem.set_input(out)
+        return out
+
+    out = layer.recurrent_group(step=step, input=x)
+    seqs = [[[1, 1, 1], [2, 2, 2], [3, 3, 3]], [[10, 0, 0], [1, 1, 1]]]
+    vals, lod = _run_seq(out, ["x"], {"x": seqs})
+    assert vals.tolist() == [[1, 1, 1], [3, 3, 3], [6, 6, 6],
+                             [10, 0, 0], [11, 1, 1]]
+    assert lod == [[0, 3, 5]]
+
+
+def test_recurrent_group_reverse():
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sequence(2))
+
+    def step(y):
+        mem = layer.memory(name="racc", size=2)
+        out = layer.addto(input=[mem, y], act=None)
+        mem.set_input(out)
+        return out
+
+    out = layer.recurrent_group(step=step, input=x, reverse=True)
+    seqs = [[[1, 0], [2, 0], [4, 0]]]
+    vals, _ = _run_seq(out, ["x"], {"x": seqs})
+    # reverse accumulation = suffix sums, in original order
+    assert vals.tolist() == [[7, 0], [6, 0], [4, 0]]
+
+
+def test_recurrent_group_static_input():
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sequence(2))
+    s = layer.data(name="s", type=v2.data_type.dense_vector(2))
+
+    def step(y, st):
+        return layer.addto(input=[y, st], act=None)
+
+    out = layer.recurrent_group(
+        step=step, input=[x, layer.StaticInput(input=s)])
+    seqs = [[[1, 1], [2, 2]]]
+    vals, _ = _run_seq(out, ["x", "s"],
+                       {"x": seqs, "s": [[10.0, 20.0]]})
+    assert vals.tolist() == [[11, 21], [12, 22]]
+
+
+def test_recurrent_group_named_memory_link():
+    """memory(name=N) links to the layer registered under N — the
+    reference's name-based wiring, no explicit set_input."""
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sequence(2))
+
+    def step(y):
+        mem = layer.memory(name="state", size=2)
+        out = layer.addto(input=[mem, y], name="state")
+        return out
+
+    out = layer.recurrent_group(step=step, input=x)
+    seqs = [[[1, 2], [3, 4]]]
+    vals, _ = _run_seq(out, ["x"], {"x": seqs})
+    assert vals.tolist() == [[1, 2], [4, 6]]
+
+
+def test_lstm_step_group():
+    """lstmemory_group pattern: lstm_step_layer + get_output_layer for
+    the cell memory."""
+    H = 4
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sequence(4 * H))
+
+    def step(y):
+        out_mem = layer.memory(name="h", size=H)
+        cell_mem = layer.memory(name="c", size=H)
+        h = layer.lstm_step_layer(input=y, state=cell_mem, size=H,
+                                  name="h")
+        layer.get_output_layer(input=h, arg_name="state", name="c")
+        return h
+
+    out = layer.recurrent_group(step=step, input=x)
+    rs = np.random.RandomState(0)
+    seqs = [rs.rand(3, 4 * H).tolist(), rs.rand(2, 4 * H).tolist()]
+    vals, lod = _run_seq(out, ["x"], {"x": seqs})
+    assert vals.shape == (5, H)
+    assert np.all(np.isfinite(vals))
+    assert lod == [[0, 3, 5]]
+
+
+def test_recurrent_layer_matches_numpy():
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sequence(2))
+    out = layer.recurrent(
+        input=x, act=v2.activation.Linear(),
+        param_attr=v2.attr.Param(initial_std=0.0, initial_mean=0.5),
+        bias_attr=False)
+    seqs = [[[1.0, 1.0], [1.0, 1.0]]]
+    vals, _ = _run_seq(out, ["x"], {"x": seqs})
+    # out_t = x_t + h_{t-1} @ W with W all 0.5 (reference
+    # RecurrentLayer semantics: input unprojected)
+    W = np.full((2, 2), 0.5, np.float32)
+    h = np.zeros(2, np.float32)
+    expect = []
+    for t in range(2):
+        h = np.ones(2, np.float32) + h @ W
+        expect.append(h.copy())
+    np.testing.assert_allclose(vals, np.asarray(expect), rtol=1e-5)
+
+
+def _build_gen_topology(V=7, E=4, H=4):
+    src = layer.data(name="src",
+                     type=v2.data_type.integer_value_sequence(V))
+    src_emb = layer.embedding(input=src, size=E)
+    enc = layer.pool(input=src_emb, pooling_type=v2.pooling.Sum)
+    boot = layer.fc(input=enc, size=H, act=v2.activation.Tanh())
+
+    def gen_step(cur_emb):
+        mem = layer.memory(name="dec", size=H, boot_layer=boot)
+        inp = layer.fc(input=[cur_emb, mem], size=H * 3, act=None)
+        g = layer.gru_step_layer(input=inp, output_mem=mem, size=H,
+                                 name="dec")
+        return layer.fc(input=g, size=V,
+                        act=v2.activation.Softmax())
+
+    return layer.beam_search(
+        step=gen_step,
+        input=[layer.GeneratedInput(size=V, embedding_name="trg_emb",
+                                    embedding_size=E)],
+        bos_id=0, eos_id=1, beam_size=3, max_length=6)
+
+
+def test_beam_search_generation():
+    beam = _build_gen_topology()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    data = [([2, 3, 4],), ([5, 6],)]
+    probs, ids = paddle.infer(output_layer=beam, input=data,
+                              field=["prob", "id"])
+    probs = np.asarray(probs)
+    assert probs.shape == (2, 3)
+    # scores sorted best-first per sample
+    assert np.all(np.diff(probs, axis=1) <= 1e-6)
+    seqs, cur = [], []
+    for w in ids:
+        if w == -1:
+            seqs.append(cur)
+            cur = []
+        else:
+            cur.append(w)
+    assert len(seqs) == 6          # 2 samples x beam 3
+    for s in seqs:
+        assert s[0] == 0 and s[-1] == 1        # bos ... eos
+        assert len(s) <= 2 + 6                 # max_length bound
+
+
+def test_seqgen_train_then_decode():
+    """End-to-end seqgen through the v2 API: train a tiny seq2seq with a
+    recurrent_group decoder (teacher forcing), then beam-decode with the
+    same parameters (reference: demo/seqToseq train.conf/gen.conf flow).
+    The model must learn to echo a constant target."""
+    V, E, H = 6, 4, 4
+    names = {"emb": "trg_emb", "in": "dec_in", "gru": "dec_gru",
+             "out": "dec_out"}
+
+    src = layer.data(name="src",
+                     type=v2.data_type.integer_value_sequence(V))
+    src_emb = layer.embedding(input=src, size=E)
+    enc = layer.pool(input=src_emb, pooling_type=v2.pooling.Sum)
+    boot = layer.fc(input=enc, size=H, act=v2.activation.Tanh(),
+                    param_attr=v2.attr.Param(name="boot_w"))
+
+    trg = layer.data(name="trg",
+                     type=v2.data_type.integer_value_sequence(V))
+    trg_emb = layer.embedding(input=trg, size=E,
+                              param_attr=v2.attr.Param(name=names["emb"]))
+    lbl = layer.data(name="lbl",
+                     type=v2.data_type.integer_value_sequence(V))
+
+    def dec_step(cur_emb):
+        mem = layer.memory(name="dec", size=H, boot_layer=boot)
+        inp = layer.fc(
+            input=[cur_emb, mem], size=H * 3, act=None,
+            param_attr=[v2.attr.Param(name=names["in"] + "_x"),
+                        v2.attr.Param(name=names["in"] + "_h")])
+        g = layer.gru_step_layer(
+            input=inp, output_mem=mem, size=H, name="dec",
+            param_attr=v2.attr.Param(name=names["gru"]))
+        return layer.fc(input=g, size=V, act=v2.activation.Softmax(),
+                        param_attr=v2.attr.Param(name=names["out"]))
+
+    dec = layer.recurrent_group(step=dec_step, input=trg_emb)
+    cost = layer.classification_cost(input=dec, label=lbl)
+
+    params = v2.parameters.create(cost)
+    trainer = v2.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=v2.optimizer.Adam(learning_rate=0.05))
+
+    # task: regardless of src, produce 2 3 then eos(1)
+    def reader():
+        rs = np.random.RandomState(7)
+        for _ in range(8):
+            batch = []
+            for _b in range(8):
+                s = rs.randint(2, V, size=3).tolist()
+                batch.append((s, [0, 2, 3], [2, 3, 1]))
+            yield batch
+
+    costs = []
+    trainer.train(
+        reader=reader, num_passes=6,
+        feeding={"src": 0, "trg": 1, "lbl": 2},
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, v2.event.EndIteration) else None)
+    assert costs[-1] < costs[0]
+
+    # generation topology sharing the learned params by name
+    def gen_step(cur_emb):
+        mem = layer.memory(name="dec", size=H, boot_layer=boot)
+        inp = layer.fc(
+            input=[cur_emb, mem], size=H * 3, act=None,
+            param_attr=[v2.attr.Param(name=names["in"] + "_x"),
+                        v2.attr.Param(name=names["in"] + "_h")])
+        g = layer.gru_step_layer(
+            input=inp, output_mem=mem, size=H, name="dec",
+            param_attr=v2.attr.Param(name=names["gru"]))
+        return layer.fc(input=g, size=V, act=v2.activation.Softmax(),
+                        param_attr=v2.attr.Param(name=names["out"]))
+
+    beam = layer.beam_search(
+        step=gen_step,
+        input=[layer.GeneratedInput(size=V, embedding_name=names["emb"],
+                                    embedding_size=E)],
+        bos_id=0, eos_id=1, beam_size=2, max_length=5)
+
+    probs, ids = paddle.infer(output_layer=beam,
+                              input=[([2, 3, 4],)],
+                              field=["prob", "id"])
+    seqs, cur = [], []
+    for w in ids:
+        if w == -1:
+            seqs.append(cur)
+            cur = []
+        else:
+            cur.append(w)
+    # best beam must have learned the target: bos 2 3 eos
+    assert seqs[0] == [0, 2, 3, 1], seqs
