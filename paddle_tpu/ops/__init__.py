@@ -28,3 +28,4 @@ from . import ctc           # noqa: F401
 from . import beam          # noqa: F401
 from . import detection     # noqa: F401
 from . import dist          # noqa: F401
+from . import v2_extra      # noqa: F401

@@ -491,6 +491,21 @@ from .registry import get_op_info as _gi_seq
 _gi_seq("sequence_to_dense").infer_shape = _sequence_to_dense_infer
 
 
+def _sequence_reshape_infer(block, op_desc):
+    # generic eval_shape priming uses a prime row count that need not be
+    # divisible by new_dim; the true output is [-1, new_dim]
+    from ..fluid.framework import _find_var_desc
+
+    xv = _find_var_desc(block, op_desc.input("X")[0])
+    out = _find_var_desc(block, op_desc.output("Out")[0])
+    out.shape = (-1, int(op_desc.attrs["new_dim"]))
+    out.dtype = xv.dtype
+    out.lod_level = max(xv.lod_level or 0, 1)
+
+
+_gi_seq("sequence_reshape").infer_shape = _sequence_reshape_infer
+
+
 @register_op("dense_to_sequence")
 def dense_to_sequence(ctx, ins, attrs):
     """Padded dense [B, maxT, ...] -> ragged with Like's row splits."""
