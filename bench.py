@@ -19,6 +19,16 @@ import numpy as np
 
 BASELINE_IMGS_PER_SEC = 82.35  # ResNet-50 batch128, IntelOptimizedPaddle.md
 
+# ResNet-50 training cost model: ~4.1 GFLOP forward per 224x224 image,
+# x3 for forward + backward (dgrad + wgrad) = ~12.3 GFLOP/img.
+TRAIN_GFLOP_PER_IMG_224 = 12.3
+
+# MFU denominator: TPU v5e peak (matches the chip the driver benches
+# on); override with BENCH_PEAK_TFLOPS for other hardware.  f32 runs
+# at roughly half the MXU's bf16 rate.
+DEFAULT_PEAK_TFLOPS_BF16 = 197.0
+DEFAULT_PEAK_TFLOPS_F32 = DEFAULT_PEAK_TFLOPS_BF16 / 2
+
 
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
@@ -27,13 +37,21 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS", "10"))
 
     import jax
+
+    # the axon sitecustomize force-selects the TPU platform at
+    # interpreter start, overriding the env var; when the caller set
+    # JAX_PLATFORMS explicitly (smoke gate -> cpu), honor it
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import paddle_tpu.fluid as fluid
     from paddle_tpu.jit import FunctionalProgram, state_from_scope
     from __graft_entry__ import _build_resnet50
 
     # bf16 MXU compute with f32 master weights is the TPU-native
     # training dtype (BENCH_AMP=0 for pure f32)
-    if os.environ.get("BENCH_AMP", "1") != "0":
+    amp_bf16 = os.environ.get("BENCH_AMP", "1") != "0"
+    if amp_bf16:
         fluid.amp.enable_bf16()
 
     main_prog, startup, logits, avg_loss = _build_resnet50(
@@ -69,11 +87,21 @@ def main():
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * iters / dt
+    step_ms = dt / iters * 1e3
+    peak_tflops = float(os.environ.get(
+        "BENCH_PEAK_TFLOPS",
+        DEFAULT_PEAK_TFLOPS_BF16 if amp_bf16 else DEFAULT_PEAK_TFLOPS_F32))
+    # scale the 224x224 FLOPs model when smoke runs at a tiny image size
+    gflop_per_img = TRAIN_GFLOP_PER_IMG_224 * (image_size / 224.0) ** 2
+    mfu = imgs_per_sec * gflop_per_img / (peak_tflops * 1e3)
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_batch%d" % batch,
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        "step_ms": round(step_ms, 2),
+        "mfu": round(mfu, 4),
+        "amp_bf16": amp_bf16,
     }))
 
 

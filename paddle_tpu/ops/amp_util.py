@@ -4,7 +4,7 @@ import jax.numpy as jnp
 
 from ..utils import flags
 
-__all__ = ["mxu_operands", "acc_kwargs", "ACC_DTYPE"]
+__all__ = ["mxu_operands", "acc_kwargs", "conv_acc_kwargs", "ACC_DTYPE"]
 
 ACC_DTYPE = jnp.float32
 
@@ -17,6 +17,19 @@ def acc_kwargs(*arrays):
            a.dtype in (jnp.bfloat16, jnp.float32) for a in arrays):
         return {"preferred_element_type": ACC_DTYPE}
     return {}
+
+
+def conv_acc_kwargs(*arrays):
+    """acc_kwargs for convolutions.  Unlike dot_general, whose transpose
+    rule casts for mixed dtypes, lax.conv_general_dilated's transpose
+    feeds the f32 cotangent of a preferred_element_type=f32 conv back
+    into a conv against the saved bf16 operand and rejects the mix.  So
+    bf16 convs stay uniform-bf16 end to end (forward and both transpose
+    convs); the MXU accumulates bf16 convs in f32 internally regardless,
+    only the output rounds to bf16."""
+    if any(hasattr(a, "dtype") and a.dtype == jnp.bfloat16 for a in arrays):
+        return {}
+    return acc_kwargs(*arrays)
 
 
 def mxu_operands(*arrays):

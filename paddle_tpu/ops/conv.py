@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
-from .amp_util import mxu_operands, acc_kwargs
+from .amp_util import mxu_operands, conv_acc_kwargs
 from ..core.ragged import RaggedTensor
 
 
@@ -34,7 +34,7 @@ def conv2d(ctx, ins, attrs):
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        **acc_kwargs(xm, wm))
+        **conv_acc_kwargs(xm, wm))
     return {"Output": [out.astype(x.dtype)]}
 
 
@@ -52,7 +52,7 @@ def conv3d(ctx, ins, attrs):
         padding=[(p, p) for p in paddings],
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        **acc_kwargs(xm, wm))
+        **conv_acc_kwargs(xm, wm))
     return {"Output": [out.astype(x.dtype)]}
 
 
@@ -78,7 +78,7 @@ def conv2d_transpose(ctx, ins, attrs):
         lhs_dilation=strides,
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        **acc_kwargs(xm, wm))
+        **conv_acc_kwargs(xm, wm))
     return {"Output": [out.astype(x.dtype)]}
 
 

@@ -5,11 +5,25 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
-export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
+# Force CPU unconditionally: the session env points JAX_PLATFORMS at the
+# single real TPU (axon tunnel); the gate must never contend for it.
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 echo "[smoke] import paddle_tpu ..."
 python -c "import paddle_tpu; import __graft_entry__; print('  ok:', len(paddle_tpu.ops.registry.registered_ops()), 'ops registered')"
+
+# The two driver entry points, exactly as the driver invokes them.  Two
+# rounds were red because the gate never ran these.  Fresh processes,
+# no env presets beyond what this script exports.
+echo "[smoke] bench.py (1 iter, tiny shapes, AMP ON — the driver default) ..."
+BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 python bench.py
+
+echo "[smoke] dryrun_multichip(8) ..."
+# Simulate the driver env exactly: JAX_PLATFORMS points at the real TPU
+# and the function itself must bootstrap the virtual CPU mesh.  timeout
+# turns a bootstrap regression (hanging on the tunnel) into a loud fail.
+timeout 300 env JAX_PLATFORMS=axon XLA_FLAGS= python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "[smoke] full test suite ..."

@@ -45,6 +45,58 @@ def test_bf16_training_converges_and_params_stay_f32():
     assert not fluid.amp.bf16_enabled()
 
 
+def test_bf16_conv_training_step():
+    """The round-2 bench crash: conv grads under bf16 AMP.  Trains the
+    driver's mini ResNet (conv+bn residual blocks) for three steps under
+    bf16_guard — exercises conv2d forward AND both transpose convs of
+    the vjp at a uniform dtype."""
+    import jax
+    from paddle_tpu.jit import FunctionalProgram, state_from_scope
+    from __graft_entry__ import _build_model, _mini_resnet
+
+    with fluid.amp.bf16_guard():
+        main, startup, _, avg_loss = _build_model(
+            _mini_resnet, 4, 16, 16, with_loss=True)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        fp = FunctionalProgram(main, ["image", "label"], [avg_loss.name])
+        state = state_from_scope(fp, scope)
+        rs = np.random.RandomState(0)
+        feeds = {"image": rs.rand(4, 3, 16, 16).astype(np.float32),
+                 "label": rs.randint(0, 16, (4, 1)).astype(np.int64)}
+        step = jax.jit(lambda s, f: fp(s, f))
+        losses = []
+        for _ in range(3):
+            fetches, state = step(state, feeds)
+            losses.append(float(np.asarray(fetches[0]).reshape(-1)[0]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_bf16_conv_transpose_grad():
+    """conv2d_transpose under AMP: forward + grad must be dtype-safe."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op_info
+
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.rand(2, 4, 8, 8).astype(np.float32))
+    w = jnp.asarray(rs.rand(4, 3, 3, 3).astype(np.float32))
+    attrs = {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1]}
+    kernel = get_op_info("conv2d_transpose").kernel
+
+    def loss(x, w):
+        out = kernel(None, {"Input": [x], "Filter": [w]}, attrs)
+        return jnp.sum(out["Output"][0] ** 2)
+
+    with fluid.amp.bf16_guard():
+        val, grads = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+    assert np.isfinite(float(val))
+    assert grads[0].shape == x.shape and grads[1].shape == w.shape
+    assert grads[0].dtype == jnp.float32
+
+
 def test_bf16_toggle_invalidates_cached_executable():
     """Same program, flag flipped between runs: results must reflect
     the new policy (cache key includes the flag)."""
