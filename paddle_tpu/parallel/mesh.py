@@ -48,7 +48,10 @@ def make_mesh(n_devices=None, dp=None, mp=1, axes=("dp", "mp"),
             # provisioned enough of them via
             # xla_force_host_platform_device_count; otherwise this is a
             # genuine under-provisioning error — say so.
-            cpu_devices = jax.devices("cpu")
+            try:
+                cpu_devices = jax.devices("cpu")
+            except RuntimeError:  # cpu backend excluded by JAX_PLATFORMS
+                cpu_devices = []
             if len(cpu_devices) >= n_devices:
                 devices = cpu_devices
             else:

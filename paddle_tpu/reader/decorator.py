@@ -1,219 +1,244 @@
 """Composable reader decorators.
 
-reference: python/paddle/v2/reader/decorator.py — map_readers, buffered,
-shuffle, chain, compose, batch(minibatch.py), cache, firstn, xmap_readers.
-A reader is a no-arg callable returning an iterable of samples.
+A "reader" is a zero-arg callable returning an iterable of samples —
+the lazy data-pipeline contract shared with the reference API
+(reference: python/paddle/v2/reader/decorator.py, minibatch.py).  The
+implementations here are built from two local primitives: generator
+composition for the synchronous decorators, and a queue-fed background
+stage (:func:`_spawn_stage`) for the threaded ones.  Ordered parallel
+map uses a heap + condition variable rather than a spin-wait.
 """
 
+import heapq
 import itertools
 import random
+import threading
 from queue import Queue
-from threading import Thread
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch"]
 
+# unique end-of-stream marker for queue-based stages (identity compare)
+_STOP = object()
+
+
+class _Failure:
+    """An exception captured in a pipeline stage, to be re-raised in
+    the consumer (a dead daemon thread would otherwise leave the
+    consumer blocked on q.get() forever, with no traceback)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _spawn_stage(target, *args, fail_q):
+    """Run `target(*args)` on a daemon thread (a pipeline stage);
+    failures are forwarded to `fail_q`, the queue the consumer drains."""
+
+    def guarded():
+        try:
+            target(*args)
+        except BaseException as exc:  # noqa: BLE001 — forwarded, not dropped
+            fail_q.put(_Failure(exc))
+
+    t = threading.Thread(target=guarded, daemon=True)
+    t.start()
+    return t
+
+
+def _drain(q):
+    """Yield items from queue `q` until the _STOP marker arrives;
+    re-raise any stage failure here, in the consuming thread."""
+    while True:
+        item = q.get()
+        if item is _STOP:
+            return
+        if isinstance(item, _Failure):
+            raise item.exc
+        yield item
+
 
 def map_readers(func, *readers):
-    def reader():
-        rs = [r() for r in readers]
-        for vals in zip(*rs):
-            yield func(*vals)
+    """Reader yielding func(a, b, ...) over parallel-zipped readers."""
 
-    return reader
+    def mapped():
+        return map(func, *(r() for r in readers))
+
+    return mapped
 
 
 def shuffle(reader, buf_size):
-    """reference: decorator.py shuffle — buffered shuffling."""
+    """Shuffle within a sliding window of `buf_size` samples."""
 
-    def data_reader():
-        buf = []
-        for e in reader():
-            buf.append(e)
-            if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for b in buf:
-                    yield b
-                buf = []
-        if len(buf) > 0:
-            random.shuffle(buf)
-            for b in buf:
-                yield b
+    def shuffled():
+        window = []
+        for sample in reader():
+            window.append(sample)
+            if len(window) >= buf_size:
+                random.shuffle(window)
+                yield from window
+                window.clear()
+        random.shuffle(window)
+        yield from window
 
-    return data_reader
+    return shuffled
 
 
 def chain(*readers):
-    def reader():
-        rs = [r() for r in readers]
-        for e in itertools.chain(*rs):
-            yield e
+    """Concatenate readers end to end."""
 
-    return reader
+    def chained():
+        return itertools.chain.from_iterable(r() for r in readers)
+
+    return chained
 
 
 class ComposeNotAligned(ValueError):
-    pass
+    """Raised when composed readers yield different sample counts."""
 
 
 def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: (a, (b, c)) -> (a, b, c).
+
+    With check_alignment (default), unequal lengths raise
+    ComposeNotAligned; otherwise the longest-exhausted prefix is used.
+    """
     check_alignment = kwargs.pop("check_alignment", True)
 
-    def make_tuple(x):
-        if isinstance(x, tuple):
-            return x
-        return (x,)
+    def as_tuple(sample):
+        return sample if isinstance(sample, tuple) else (sample,)
 
-    def reader():
-        rs = [r() for r in readers]
-        if not check_alignment:
-            for outputs in zip(*rs):
-                yield sum(list(map(make_tuple, outputs)), ())
+    def composed():
+        iters = [r() for r in readers]
+        if check_alignment:
+            rows = itertools.zip_longest(*iters, fillvalue=_STOP)
         else:
-            for outputs in itertools.zip_longest(*rs):
-                for o in outputs:
-                    if o is None:
-                        raise ComposeNotAligned(
-                            "outputs of readers are not aligned")
-                yield sum(list(map(make_tuple, outputs)), ())
+            rows = zip(*iters)
+        for row in rows:
+            # identity check: samples may be numpy arrays, where ==
+            # broadcasts and `in` would raise
+            if any(s is _STOP for s in row):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield tuple(itertools.chain.from_iterable(map(as_tuple, row)))
 
-    return reader
+    return composed
 
 
 def buffered(reader, size):
-    """reference: decorator.py buffered — producer thread + queue."""
+    """Decouple production from consumption via a bounded queue."""
 
-    class EndSignal:
-        pass
+    def produce(src, q):
+        for sample in src:
+            q.put(sample)
+        q.put(_STOP)
 
-    end = EndSignal()
-
-    def read_worker(r, q):
-        for d in r:
-            q.put(d)
-        q.put(end)
-
-    def data_reader():
-        r = reader()
+    def buffered_reader():
         q = Queue(maxsize=size)
-        t = Thread(target=read_worker, args=(r, q))
-        t.daemon = True
-        t.start()
-        e = q.get()
-        while e is not end:
-            yield e
-            e = q.get()
+        _spawn_stage(produce, reader(), q, fail_q=q)
+        yield from _drain(q)
 
-    return data_reader
+    return buffered_reader
 
 
 def firstn(reader, n):
-    def firstn_reader():
-        for i, item in enumerate(reader()):
-            if i == n:
-                break
-            yield item
+    """Truncate a reader to its first n samples."""
 
-    return firstn_reader
+    def truncated():
+        return itertools.islice(reader(), n)
+
+    return truncated
 
 
 def cache(reader):
-    all_data = tuple(reader())
+    """Materialize the reader once; replay from memory thereafter."""
+    samples = tuple(reader())
 
-    def cache_reader():
-        for item in all_data:
-            yield item
+    def replay():
+        return iter(samples)
 
-    return cache_reader
+    return replay
 
 
-class XmapEndSignal:
-    pass
+class _OrderedEmitter:
+    """Re-serialize (seq, value) pairs from racing workers.
+
+    Workers hand results in any order; emit() releases them to the
+    output queue strictly by sequence number, parking early arrivals
+    in a heap.  Never blocks (beyond the out-queue's own bound) —
+    backpressure comes from the bounded queues."""
+
+    def __init__(self, out_queue):
+        self._out = out_queue
+        self._next = 0
+        self._parked = []
+        self._lock = threading.Lock()
+
+    def emit(self, seq, value):
+        with self._lock:
+            heapq.heappush(self._parked, (seq, value))
+            while self._parked and self._parked[0][0] == self._next:
+                _, ready = heapq.heappop(self._parked)
+                self._out.put(ready)
+                self._next += 1
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map over a reader with worker threads
-    (reference: decorator.py xmap_readers)."""
-    end = XmapEndSignal()
+    """Apply `mapper` to samples on `process_num` worker threads.
 
-    def read_worker(reader, in_queue):
-        for i in reader():
-            in_queue.put(i)
-        in_queue.put(end)
+    With order=True, output order matches input order (at the cost of
+    head-of-line buffering); otherwise results stream as completed.
+    """
 
-    def order_read_worker(reader, in_queue):
-        for order_id, sample in enumerate(reader()):
-            in_queue.put((order_id, sample))
-        in_queue.put(end)
+    def feed(src, in_q):
+        for seq, sample in enumerate(src):
+            in_q.put((seq, sample))
+        for _ in range(process_num):
+            in_q.put(_STOP)  # one stop marker per worker
 
-    def handle_worker(in_queue, out_queue, mapper):
-        sample = in_queue.get()
-        while not isinstance(sample, XmapEndSignal):
-            out_queue.put(mapper(sample))
-            sample = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
-
-    def order_handle_worker(in_queue, out_queue, mapper, out_order):
-        ins = in_queue.get()
-        while not isinstance(ins, XmapEndSignal):
-            order_id, sample = ins
+    def work(in_q, out_q, emitter, done):
+        for seq, sample in _drain(in_q):
             result = mapper(sample)
-            while order_id != out_order[0]:
-                pass
-            out_queue.put(result)
-            out_order[0] += 1
-            ins = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
+            if emitter is not None:
+                emitter.emit(seq, result)
+            else:
+                out_q.put(result)
+        with done["lock"]:
+            done["count"] += 1
+            if done["count"] == process_num:
+                out_q.put(_STOP)
 
-    def xreader():
-        in_queue = Queue(buffer_size)
-        out_queue = Queue(buffer_size)
-        out_order = [0]
-        target = order_read_worker if order else read_worker
-        t = Thread(target=target, args=(reader, in_queue))
-        t.daemon = True
-        t.start()
-        target = order_handle_worker if order else handle_worker
-        args = (in_queue, out_queue, mapper, out_order) if order else (
-            in_queue, out_queue, mapper)
-        workers = []
-        for i in range(process_num):
-            worker = Thread(target=target, args=args)
-            worker.daemon = True
-            workers.append(worker)
-        for w in workers:
-            w.start()
+    def xmapped():
+        in_q = Queue(buffer_size)
+        out_q = Queue(buffer_size)
+        emitter = _OrderedEmitter(out_q) if order else None
+        done = {"lock": threading.Lock(), "count": 0}
+        # failures (reader or mapper) surface on out_q: the consumer
+        # re-raises; remaining daemon workers are abandoned
+        _spawn_stage(feed, reader(), in_q, fail_q=out_q)
+        for _ in range(process_num):
+            _spawn_stage(work, in_q, out_q, emitter, done, fail_q=out_q)
+        yield from _drain(out_q)
 
-        finish = 0
-        sample = out_queue.get()
-        while not isinstance(sample, XmapEndSignal):
-            yield sample
-            sample = out_queue.get()
-            while isinstance(sample, XmapEndSignal):
-                finish += 1
-                if finish == process_num:
-                    return
-                sample = out_queue.get()
-
-    return xreader
+    return xmapped
 
 
 def batch(reader, batch_size, drop_last=True):
-    """reference: python/paddle/v2/minibatch.py — group samples into lists.
-    drop_last defaults True on TPU: fixed batch shapes avoid XLA
-    recompilation for the ragged tail batch."""
+    """Group samples into lists of `batch_size`.
 
-    def batch_reader():
-        r = reader()
-        b = []
-        for instance in r:
-            b.append(instance)
-            if len(b) == batch_size:
-                yield b
-                b = []
-        if b and not drop_last:
-            yield b
+    drop_last defaults True on TPU: a ragged tail batch would change
+    the feed shape and force an XLA recompile.
+    """
 
-    return batch_reader
+    def batched():
+        it = iter(reader())
+        while True:
+            group = list(itertools.islice(it, batch_size))
+            if len(group) == batch_size:
+                yield group
+            else:
+                if group and not drop_last:
+                    yield group
+                return
+
+    return batched

@@ -1,0 +1,128 @@
+"""Reader decorator semantics (reference: v2/reader/tests/decorator_test.py,
+creator_test.py — same behavioral contract, own implementation)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.reader as reader
+
+
+def _counting(n):
+    def r():
+        return iter(range(n))
+
+    return r
+
+
+def test_map_readers():
+    out = list(reader.map_readers(lambda a, b: a + b,
+                                  _counting(4), _counting(4))())
+    assert out == [0, 2, 4, 6]
+
+
+def test_shuffle_is_permutation():
+    out = list(reader.shuffle(_counting(10), buf_size=4)())
+    assert sorted(out) == list(range(10))
+
+
+def test_chain():
+    out = list(reader.chain(_counting(2), _counting(3))())
+    assert out == [0, 1, 0, 1, 2]
+
+
+def test_compose_flattens_tuples():
+    def pairs():
+        for i in range(3):
+            yield (i, i * 10)
+
+    out = list(reader.compose(_counting(3), pairs)())
+    assert out == [(0, 0, 0), (1, 1, 10), (2, 2, 20)]
+
+
+def test_compose_misaligned_raises():
+    from paddle_tpu.reader.decorator import ComposeNotAligned
+
+    misaligned = reader.compose(_counting(3), _counting(5))
+    with pytest.raises(ComposeNotAligned):
+        list(misaligned())
+
+
+def test_compose_unchecked_stops_at_shortest():
+    out = list(reader.compose(_counting(3), _counting(5),
+                              check_alignment=False)())
+    assert len(out) == 3
+
+
+def test_compose_numpy_samples():
+    """Samples may be arrays; the alignment check must not broadcast."""
+
+    def arrays():
+        for i in range(3):
+            yield np.full((4,), i)
+
+    out = list(reader.compose(arrays, arrays)())
+    assert len(out) == 3 and len(out[0]) == 2
+
+
+def test_buffered_preserves_order():
+    out = list(reader.buffered(_counting(100), size=7)())
+    assert out == list(range(100))
+
+
+def test_firstn():
+    assert list(reader.firstn(_counting(100), 5)()) == [0, 1, 2, 3, 4]
+    assert list(reader.firstn(_counting(3), 5)()) == [0, 1, 2]
+
+
+def test_cache_replays_single_pass_source():
+    calls = []
+
+    def once():
+        calls.append(1)
+        return iter(range(4))
+
+    cached = reader.cache(once)
+    assert list(cached()) == list(cached()) == [0, 1, 2, 3]
+    assert len(calls) == 1
+
+
+@pytest.mark.parametrize("order", [False, True])
+def test_xmap_readers(order):
+    out = list(reader.xmap_readers(lambda x: x * 2, _counting(50),
+                                   process_num=4, buffer_size=8,
+                                   order=order)())
+    if order:
+        assert out == [2 * i for i in range(50)]
+    else:
+        assert sorted(out) == [2 * i for i in range(50)]
+
+
+def test_buffered_propagates_reader_exception():
+    def failing():
+        yield 1
+        raise RuntimeError("corrupt source")
+
+    it = reader.buffered(failing, size=4)()
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="corrupt source"):
+        list(it)
+
+
+@pytest.mark.parametrize("order", [False, True])
+def test_xmap_propagates_mapper_exception(order):
+    def mapper(x):
+        if x == 7:
+            raise ValueError("bad sample")
+        return x
+
+    it = reader.xmap_readers(mapper, _counting(50), process_num=2,
+                             buffer_size=4, order=order)()
+    with pytest.raises(ValueError, match="bad sample"):
+        list(it)
+
+
+def test_batch_shapes():
+    batches = list(reader.batch(_counting(10), 4)())
+    assert [len(b) for b in batches] == [4, 4]  # drop_last default
+    batches = list(reader.batch(_counting(10), 4, drop_last=False)())
+    assert [len(b) for b in batches] == [4, 4, 2]
