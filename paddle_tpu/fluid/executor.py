@@ -16,6 +16,8 @@ reference's interpreter for debugging, per-op profiling and nan checks
 (reference: executor.cc:29 FLAGS_check_nan_inf).
 """
 
+import time
+
 import numpy as np
 
 import jax
@@ -347,7 +349,12 @@ class _CompiledProgram:
 
         rng_state = scope.get(RNG_STATE_NAME)
         if rng_state is None:
-            rng_state = jax.random.PRNGKey(self.program.random_seed or 0)
+            # committed placement, like the jit-returned key that will
+            # replace it: an uncommitted first key makes every jitted
+            # segment retrace (and recompile) on its second run
+            rng_state = jax.device_put(
+                jax.random.PRNGKey(self.program.random_seed or 0),
+                executor.place.device())
             scope.set_local(RNG_STATE_NAME, rng_state)
 
         for i, seg in enumerate(self._plan):
@@ -383,7 +390,15 @@ class _CompiledProgram:
         return [env[n] if n in env else scope.get(n)
                 for n in self.fetch_names]
 
+    def _segment_label(self, i, seg):
+        """Stable display name: index + op-type span + op count."""
+        types = [od.type for od in seg["ops"]]
+        span = types[0] if len(types) == 1 else "%s..%s" % (types[0],
+                                                            types[-1])
+        return "jit_segment[%d:%s x%d]" % (i, span, len(types))
+
     def _run_jit_segment(self, i, seg, in_vals, rng_state):
+        first_call = i not in self._jit_cache
         jitted = self._jit_cache.get(i)
         if jitted is None:
             ops = seg["ops"]
@@ -411,7 +426,24 @@ class _CompiledProgram:
         mutated = jitted["mutated"]
         mut_ins = {n: v for n, v in in_vals.items() if n in mutated}
         ro_ins = {n: v for n, v in in_vals.items() if n not in mutated}
+        if not profiler_mod.is_enabled():
+            outs, rng = jitted["fn"](mut_ins, ro_ins, rng_state)
+            return outs, rng
+        # profiled: block on the segment's outputs so the wall time is
+        # the device time, not just the dispatch (ParseEvents analog for
+        # the compiled path; per-op rows come from eager mode).  A trace
+        # hit (new shapes/dtypes) also lands in the /first(trace) row.
+        label = self._segment_label(i, seg)
+        pre_traces = getattr(jitted["fn"], "_cache_size", lambda: None)()
+        t0 = time.perf_counter()
         outs, rng = jitted["fn"](mut_ins, ro_ins, rng_state)
+        jax.block_until_ready((outs, rng))
+        dt = time.perf_counter() - t0
+        traced = first_call or (
+            pre_traces is not None
+            and jitted["fn"]._cache_size() > pre_traces)
+        profiler_mod.record(
+            label + ("/first(trace)" if traced else ""), dt)
         return outs, rng
 
 
@@ -453,7 +485,7 @@ class Executor:
 
         # dtype policy is trace-time state: a flipped amp flag must not
         # reuse executables traced under the old policy
-        key = (id(program), program.version, 0,
+        key = (program._cache_token, program.version, 0,
                tuple(sorted(feed_env.keys())), tuple(fetch_names),
                flags.get_flag("amp_bf16"))
         compiled = self._cache.get(key) if use_program_cache else None

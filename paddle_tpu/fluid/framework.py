@@ -13,6 +13,7 @@ registers an explicit rule.
 
 import contextlib
 import copy
+import itertools
 
 from ..core.desc import ProgramDesc, BlockDesc, OpDesc, VarDesc, BlockRef
 from ..core.types import VarType, canonical_dtype
@@ -317,6 +318,10 @@ def _var_names(v):
 class Program:
     """reference: framework.py:789."""
 
+    # process-wide monotonic id: unlike id(), never reused after GC, so
+    # executor caches keyed on it can never alias two programs
+    _token_counter = itertools.count()
+
     def __init__(self):
         self.desc = ProgramDesc()
         self.blocks = [Block(self, 0)]
@@ -324,6 +329,7 @@ class Program:
         self.random_seed = 0
         self._version = 0
         self._seed_counter = 0
+        self._cache_token = next(Program._token_counter)
 
     def _bump_version(self):
         self._version += 1

@@ -304,3 +304,32 @@ def im2sequence(ctx, ins, attrs):
     seq = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     splits = jnp.arange(n + 1, dtype=jnp.int32) * (oh * ow)
     return {"Out": [RaggedTensor(seq, [splits])]}
+
+
+@register_op("conv2d_dynamic_filter")
+def conv2d_dynamic_filter(ctx, ins, attrs):
+    """Per-sample dynamic-filter convolution: each batch element is
+    convolved with its own filter row (reference: ConvOperator.cpp via
+    layers.py conv_operator — the mixed-layer operator whose filter is
+    another layer's output, not a parameter).  Lowered to a vmap of
+    single-image convs; XLA batches them onto the MXU."""
+    x = ins["Input"][0]                        # [B, C, H, W]
+    w = ins["Filter"][0]                       # [B, F*C*kh*kw]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0]))
+    f = int(attrs["num_filters"])
+    kh, kw = attrs.get("ksize", [3, 3])
+    c = x.shape[1]
+
+    def one(img, flt):
+        im, fm = mxu_operands(img[None], flt.reshape(f, c, kh, kw))
+        out = lax.conv_general_dilated(
+            im, fm, window_strides=strides,
+            padding=[(paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            **conv_acc_kwargs(im, fm))
+        return out[0]
+
+    out = jax.vmap(one)(x, w)
+    return {"Output": [out.astype(x.dtype)]}

@@ -11,6 +11,7 @@ reference runs bipartite_match CPU-only as well.
 """
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .registry import register_op
@@ -244,3 +245,115 @@ def detection_output(ctx, ins, attrs):
     if not rows:
         rows = [[-1.0] * 7]
     return {"Out": [np.asarray(rows, np.float32)]}
+
+
+@register_op("multibox_loss",
+             nondiff_inputs=("PriorBox", "GtBox", "GtLabel"))
+def multibox_loss(ctx, ins, attrs):
+    """SSD training loss (reference: MultiBoxLossLayer.cpp via
+    multibox_loss_layer, layers.py): per-prediction IoU matching,
+    variance-encoded smooth-L1 location loss on positives, softmax
+    confidence loss with 3:1 hard-negative mining.
+
+    Unlike the reference's sequential CPU matching, everything here is
+    a fixed-shape masked computation — matching, mining, and both
+    losses trace into one XLA program, so the op is differentiable
+    w.r.t. Loc/Conf and fuses into the training step.
+
+    Loc: [N, P*4]; Conf: [N, P*C]; PriorBox: [2P, 4] (boxes then
+    variances); GtBox: ragged [G, 4]; GtLabel: ragged [G, 1].
+    Loss: [N, 1] per-image cost.
+    """
+    num_classes = int(attrs["num_classes"])
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    background = int(attrs.get("background_label_id", 0))
+
+    loc = ins["Loc"][0]
+    conf = ins["Conf"][0]
+    prior = ins["PriorBox"][0].reshape(-1, 4)
+    gt_box_t = ins["GtBox"][0]
+    gt_label_t = ins["GtLabel"][0]
+
+    n_prior = prior.shape[0] // 2
+    pboxes, pvars = prior[:n_prior], prior[n_prior:]
+    N = loc.shape[0]
+    loc = loc.reshape(N, n_prior, 4)
+    conf = conf.reshape(N, n_prior, num_classes)
+
+    gt_boxes = gt_box_t.values if isinstance(gt_box_t, RaggedTensor) \
+        else gt_box_t
+    gt_labels = (gt_label_t.values if isinstance(gt_label_t,
+                                                 RaggedTensor)
+                 else gt_label_t).reshape(-1).astype(jnp.int32)
+    if isinstance(gt_box_t, RaggedTensor):
+        splits = gt_box_t.last_splits()
+    else:
+        splits = jnp.asarray([0, gt_boxes.shape[0]], jnp.int32)
+    G = gt_boxes.shape[0]
+    # image membership of each gt row: img[g] = n iff splits[n] <= g
+    img_of_gt = jnp.searchsorted(splits[1:], jnp.arange(G), side="right")
+
+    iou = _iou(pboxes, gt_boxes)                      # [P, G]
+    member = img_of_gt[None, :] == jnp.arange(N)[:, None, None]  # [N,1,G]
+    iou_n = jnp.where(member, iou[None], -1.0)        # [N, P, G]
+    best_gt = jnp.argmax(iou_n, axis=-1)              # [N, P]
+    best_iou = jnp.take_along_axis(iou_n, best_gt[..., None],
+                                   -1)[..., 0]        # [N, P]
+    positive = best_iou >= overlap_threshold
+
+    # bipartite step (reference: MultiBoxLossLayer.cpp matches each gt
+    # to its best prior unconditionally BEFORE per-prediction
+    # thresholding) — without it a gt whose best IoU is under the
+    # threshold would contribute no gradient at all
+    valid_gt = member[:, 0, :]                        # [N, G]
+    best_prior = jnp.argmax(iou_n, axis=1)            # [N, G]
+    gt_hits_prior = (jax.nn.one_hot(best_prior, n_prior, dtype=bool)
+                     & valid_gt[..., None])           # [N, G, P]
+    forced = jnp.any(gt_hits_prior, axis=1)           # [N, P]
+    # a forced prior adopts its highest-IoU forcing gt
+    forced_iou = jnp.where(jnp.swapaxes(gt_hits_prior, 1, 2),
+                           iou[None], -1.0)           # [N, P, G]
+    best_gt = jnp.where(forced, jnp.argmax(forced_iou, -1), best_gt)
+    positive = positive | forced
+
+    matched_box = gt_boxes[best_gt]                   # [N, P, 4]
+    matched_label = gt_labels[best_gt]                # [N, P]
+
+    # encode matched gt against priors (center-size, variance-scaled)
+    pw = pboxes[:, 2] - pboxes[:, 0]
+    ph = pboxes[:, 3] - pboxes[:, 1]
+    pcx = (pboxes[:, 0] + pboxes[:, 2]) / 2
+    pcy = (pboxes[:, 1] + pboxes[:, 3]) / 2
+    gw = jnp.maximum(matched_box[..., 2] - matched_box[..., 0], 1e-6)
+    gh = jnp.maximum(matched_box[..., 3] - matched_box[..., 1], 1e-6)
+    gcx = (matched_box[..., 0] + matched_box[..., 2]) / 2
+    gcy = (matched_box[..., 1] + matched_box[..., 3]) / 2
+    target = jnp.stack(
+        [(gcx - pcx) / pw / pvars[:, 0], (gcy - pcy) / ph / pvars[:, 1],
+         jnp.log(gw / pw) / pvars[:, 2], jnp.log(gh / ph) / pvars[:, 3]],
+        axis=-1)                                      # [N, P, 4]
+
+    diff = jnp.abs(loc - target)
+    smooth_l1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    loc_loss = jnp.sum(jnp.sum(smooth_l1, -1) * positive, -1)  # [N]
+
+    # softmax CE per prior; positives use the matched label,
+    # negatives the background class
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    cls = jnp.where(positive, matched_label, background)
+    ce = -jnp.take_along_axis(logp, cls[..., None], -1)[..., 0]  # [N,P]
+
+    # hard negative mining: keep the neg_pos_ratio * npos highest-loss
+    # negatives per image (rank via argsort-of-argsort, fixed shapes)
+    npos = jnp.sum(positive, -1)                      # [N]
+    neg_ce = jnp.where(positive, -jnp.inf, ce)
+    order = jnp.argsort(-neg_ce, axis=-1)
+    rank = jnp.argsort(order, axis=-1)
+    n_neg = jnp.minimum(neg_pos_ratio * npos, n_prior - npos)
+    negative = (~positive) & (rank < n_neg[:, None])
+    conf_loss = jnp.sum(ce * (positive | negative), -1)  # [N]
+
+    denom = jnp.maximum(npos.astype(loc.dtype), 1.0)
+    loss = (loc_loss + conf_loss) / denom
+    return {"Loss": [loss[:, None]]}

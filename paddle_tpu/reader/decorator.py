@@ -165,22 +165,33 @@ class _OrderedEmitter:
 
     Workers hand results in any order; emit() releases them to the
     output queue strictly by sequence number, parking early arrivals
-    in a heap.  Never blocks (beyond the out-queue's own bound) —
-    backpressure comes from the bounded queues."""
+    in a heap.  A worker that has raced more than `bound` results
+    ahead of the release point blocks until the head of line moves —
+    without this, one slow sample would let the heap buffer the whole
+    mapped dataset (the bounded queues give no backpressure while the
+    output queue stays empty)."""
 
-    def __init__(self, out_queue):
+    def __init__(self, out_queue, bound):
         self._out = out_queue
+        self._bound = max(int(bound), 1)
         self._next = 0
         self._parked = []
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
 
     def emit(self, seq, value):
-        with self._lock:
+        with self._cv:
+            # the worker holding the next-needed seq never waits
+            while seq - self._next >= self._bound and seq != self._next:
+                self._cv.wait()
             heapq.heappush(self._parked, (seq, value))
+            released = False
             while self._parked and self._parked[0][0] == self._next:
                 _, ready = heapq.heappop(self._parked)
                 self._out.put(ready)
                 self._next += 1
+                released = True
+            if released:
+                self._cv.notify_all()
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
@@ -211,7 +222,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def xmapped():
         in_q = Queue(buffer_size)
         out_q = Queue(buffer_size)
-        emitter = _OrderedEmitter(out_q) if order else None
+        emitter = _OrderedEmitter(out_q, buffer_size) if order else None
         done = {"lock": threading.Lock(), "count": 0}
         # failures (reader or mapper) surface on out_q: the consumer
         # re-raises; remaining daemon workers are abandoned

@@ -80,6 +80,89 @@ table_projection = _layer.table_projection
 dotmul_projection = _layer.dotmul_projection
 context_projection = _layer.context_projection
 
+trans_full_matrix_projection = _layer.trans_full_matrix_projection
+scaling_projection = _layer.scaling_projection
+slice_projection = _layer.slice_projection
+conv_projection = _layer.conv_projection
+dotmul_operator = _layer.dotmul_operator
+conv_operator = _layer.conv_operator
+
+# recurrent surface
+StaticInput = _layer.StaticInput
+SubsequenceInput = _layer.SubsequenceInput
+GeneratedInput = _layer.GeneratedInput
+memory = _layer.memory
+recurrent_group = _layer.recurrent_group
+beam_search = _layer.beam_search
+get_output_layer = _layer.get_output_layer
+eos_layer = _layer.eos_layer
+gru_step_layer = _layer.gru_step_layer
+gru_step_naive_layer = _layer.gru_step_naive_layer
+lstm_step_layer = _layer.lstm_step_layer
+recurrent_layer = _layer.recurrent
+
+# extended zoo (reference *_layer names)
+repeat_layer = _layer.repeat
+seq_reshape_layer = _layer.seq_reshape
+interpolation_layer = _layer.interpolation
+power_layer = _layer.power
+sum_to_one_norm_layer = _layer.sum_to_one_norm
+row_l2_norm_layer = _layer.row_l2_norm
+dot_prod_layer = _layer.dot_prod
+l2_distance_layer = _layer.l2_distance
+clip_layer = _layer.clip
+resize_layer = _layer.resize
+switch_order_layer = _layer.switch_order
+scale_shift_layer = _layer.scale_shift
+sub_seq_layer = _layer.sub_seq
+seq_slice_layer = _layer.seq_slice
+kmax_seq_score_layer = _layer.kmax_seq_score
+sub_nested_seq_layer = _layer.sub_nested_seq
+factorization_machine = _layer.factorization_machine
+gated_unit_layer = _layer.gated_unit
+tensor_layer = _layer.tensor
+selective_fc_layer = _layer.selective_fc
+maxout_layer = _layer.maxout
+spp_layer = _layer.spp
+img_cmrnorm_layer = _layer.img_cmrnorm
+cross_channel_norm_layer = _layer.cross_channel_norm
+img_pool3d_layer = _layer.img_pool3d
+img_conv3d_layer = _layer.img_conv3d
+block_expand_layer = _layer.block_expand
+bilinear_interp_layer = _layer.bilinear_interp
+rotate_layer = _layer.rotate
+out_prod_layer = _layer.out_prod
+linear_comb_layer = _layer.linear_comb
+convex_comb_layer = _layer.convex_comb
+conv_shift_layer = _layer.conv_shift
+pad_layer = _layer.pad
+crop_layer = _layer.crop
+scale_sub_region_layer = _layer.scale_sub_region
+prelu_layer = _layer.prelu
+multiplex_layer = _layer.multiplex
+row_conv_layer = _layer.row_conv
+sampling_id_layer = _layer.sampling_id
+printer_layer = _layer.printer
+
+# costs
+hsigmoid = _layer.hsigmoid
+nce_layer = _layer.nce
+ctc_layer = _layer.ctc
+warp_ctc_layer = _layer.warp_ctc
+rank_cost = _layer.rank_cost
+lambda_cost = _layer.lambda_cost
+cross_entropy_with_selfnorm = _layer.cross_entropy_with_selfnorm
+multi_binary_label_cross_entropy = _layer.multi_binary_label_cross_entropy
+huber_regression_cost = _layer.huber_regression_cost
+huber_classification_cost = _layer.huber_classification_cost
+smooth_l1_cost = _layer.smooth_l1_cost
+
+# detection
+priorbox_layer = _layer.priorbox
+roi_pool_layer = _layer.roi_pool
+detection_output_layer = _layer.detection_output
+multibox_loss_layer = _layer.multibox_loss
+
 # networks (reference: trainer_config_helpers/networks.py)
 simple_img_conv_pool = _networks.simple_img_conv_pool
 img_conv_group = _networks.img_conv_group

@@ -21,12 +21,35 @@ __all__ = [
     "square_error_cost", "regression_cost", "mse_cost", "crf",
     "crf_decoding", "max_id", "seq_concat", "expand", "cos_sim",
     "scaling", "slope_intercept", "sum_cost", "trans", "mixed",
+    # projections / operators (mixed-layer family)
     "full_matrix_projection", "identity_projection", "table_projection",
     "dotmul_projection", "context_projection",
+    "trans_full_matrix_projection", "scaling_projection",
+    "slice_projection", "conv_projection", "dotmul_operator",
+    "conv_operator",
     # recurrent surface
     "StaticInput", "SubsequenceInput", "GeneratedInput", "memory",
     "recurrent_group", "beam_search", "get_output_layer", "eos_layer",
-    "maxid_layer", "gru_step_layer", "lstm_step_layer", "recurrent",
+    "maxid_layer", "gru_step_layer", "gru_step_naive_layer",
+    "lstm_step_layer", "recurrent",
+    # extended zoo
+    "repeat", "seq_reshape", "interpolation", "power",
+    "sum_to_one_norm", "row_l2_norm", "dot_prod", "l2_distance",
+    "clip", "resize", "switch_order", "scale_shift", "sub_seq",
+    "seq_slice", "kmax_seq_score", "sub_nested_seq",
+    "factorization_machine", "gated_unit", "tensor", "selective_fc",
+    "maxout", "spp", "img_cmrnorm", "cross_channel_norm", "img_pool3d",
+    "img_conv3d", "block_expand", "bilinear_interp", "rotate",
+    "out_prod", "linear_comb", "convex_comb", "conv_shift", "pad",
+    "crop", "scale_sub_region", "prelu", "multiplex", "row_conv",
+    "dropout_layer", "sampling_id", "printer",
+    # costs
+    "hsigmoid", "nce", "ctc", "warp_ctc", "rank_cost", "lambda_cost",
+    "cross_entropy_with_selfnorm", "multi_binary_label_cross_entropy",
+    "huber_regression_cost", "huber_classification_cost",
+    "smooth_l1_cost",
+    # detection
+    "priorbox", "roi_pool", "detection_output", "multibox_loss",
 ]
 
 def _act_name(act):
@@ -303,6 +326,137 @@ def context_projection(input, context_len, context_start=None):
     return _Projection(lambda: fl.sequence_conv(
         input=input, num_filters=input.shape[-1],
         filter_size=context_len, bias_attr=False))
+
+
+def trans_full_matrix_projection(input, size, param_attr=None):
+    """out = x W^T with W [size, in] (reference: layers.py
+    trans_full_matrix_projection / TransposedFullMatrixProjection) —
+    lets tied weights be shared with an ordinary projection."""
+
+    def build():
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("trans_fm_projection", param_attr=param_attr)
+        w = helper.create_parameter(helper.param_attr,
+                                    shape=[size, input.shape[-1]],
+                                    dtype=input.dtype)
+        return fl.matmul(x=input, y=w, transpose_y=True)
+
+    return _Projection(build)
+
+
+def scaling_projection(input, param_attr=None):
+    """out = w * x with one learned scalar w (reference: layers.py
+    scaling_projection over ScalingProjection.cpp)."""
+
+    def build():
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("scaling_projection", param_attr=param_attr)
+        w = helper.create_parameter(helper.param_attr, shape=[1],
+                                    dtype=input.dtype)
+        return fl.elementwise_mul(x=input, y=w)
+
+    return _Projection(build)
+
+
+def slice_projection(input, slices):
+    """Concatenation of column ranges [(start, end), ...] of the input
+    (reference: layers.py slice_projection over SliceProjection.cpp).
+    Lowered to transpose + one gather of the selected columns."""
+    for s, e in slices:
+        if not (0 <= s < e <= input.shape[-1]):
+            raise ValueError("bad slice (%d, %d) for width %d"
+                             % (s, e, input.shape[-1]))
+
+    def build():
+        from ..fluid.layer_helper import LayerHelper
+
+        cols = [c for s, e in slices for c in range(s, e)]
+        helper = LayerHelper("slice_projection")
+        idx = helper.create_tmp_variable("int32")
+        idx.stop_gradient = True
+        helper.append_op(type="assign_value", inputs={},
+                         outputs={"Out": [idx]},
+                         attrs={"shape": [len(cols)], "dtype": "int32",
+                                "values": cols})
+        t = fl.transpose(x=input, perm=[1, 0])
+        picked = helper.create_tmp_variable(input.dtype)
+        helper.append_op(type="gather",
+                         inputs={"X": [t], "Index": [idx]},
+                         outputs={"Out": [picked]})
+        return fl.transpose(x=picked, perm=[1, 0])
+
+    return _Projection(build)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None):
+    """Learned-filter conv feature map for a mixed layer (reference:
+    layers.py conv_projection; bias/activation belong to the mixed)."""
+
+    def build():
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("conv_projection", param_attr=param_attr)
+        cin = num_channels or input.shape[1]
+        k = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 2
+        s = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+        p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 2
+        w = helper.create_parameter(helper.param_attr,
+                                    shape=[num_filters, cin] + list(k),
+                                    dtype=input.dtype)
+        out = helper.create_tmp_variable(input.dtype)
+        helper.append_op(type="conv2d",
+                         inputs={"Input": [input], "Filter": [w]},
+                         outputs={"Output": [out]},
+                         attrs={"strides": list(s), "paddings": list(p),
+                                "dilations": [1, 1], "groups": 1})
+        return out
+
+    return _Projection(build)
+
+
+def dotmul_operator(a, b, scale=1.0):
+    """Elementwise a .* b operator for a mixed layer (reference:
+    layers.py dotmul_operator over DotMulOperator.cpp)."""
+
+    def build():
+        out = fl.elementwise_mul(x=a, y=b)
+        if scale != 1.0:
+            out = fl.scale(x=out, scale=float(scale))
+        return out
+
+    return _Projection(build)
+
+
+def conv_operator(img, filter, filter_size, num_filters,
+                  num_channels=None, stride=1, padding=0,
+                  filter_size_y=None, stride_y=None, padding_y=None):
+    """Convolve each sample of `img` with its own filter row produced
+    by another layer (reference: layers.py conv_operator over
+    ConvOperator.cpp — per-sample dynamic filters)."""
+
+    def build():
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("conv_operator")
+        kx = filter_size
+        ky = filter_size if filter_size_y is None else filter_size_y
+        s = [stride if stride_y is None else stride_y, stride]
+        p = [padding if padding_y is None else padding_y, padding]
+        out = helper.create_tmp_variable(img.dtype)
+        helper.append_op(type="conv2d_dynamic_filter",
+                         inputs={"Input": [img], "Filter": [filter]},
+                         outputs={"Output": [out]},
+                         attrs={"strides": s, "paddings": p,
+                                "num_filters": int(num_filters),
+                                "ksize": [ky, kx]})
+        return out
+
+    return _Projection(build)
 
 
 def mixed(size=None, input=None, act=None, bias_attr=None, name=None,
@@ -937,3 +1091,24 @@ def detection_output(input_loc, input_conf, priorbox, num_classes,
          "score_threshold": float(confidence_threshold),
          "background_label": int(background_id)},
         name=name, lod_level=1, stop_gradient=True)
+
+
+def multibox_loss(input_loc, input_conf, priorbox, label, gt_box,
+                  num_classes, overlap_threshold=0.5,
+                  neg_pos_ratio=3.0, background_id=0, name=None, **kw):
+    """SSD training cost (reference: layers.py multibox_loss_layer over
+    MultiBoxLossLayer.cpp).  `gt_box` is the ragged [G, 4] ground-truth
+    box sequence and `label` its ragged [G, 1] class ids — the
+    reference packs both into one label blob; they are separate data
+    layers here.  Returns the mean per-image loss."""
+    out = _helper_op(
+        "multibox_loss",
+        {"Loc": [input_loc], "Conf": [input_conf],
+         "PriorBox": [priorbox], "GtBox": [gt_box],
+         "GtLabel": [label]},
+        {"num_classes": int(num_classes),
+         "overlap_threshold": float(overlap_threshold),
+         "neg_pos_ratio": float(neg_pos_ratio),
+         "background_label_id": int(background_id)},
+        out_slots=("Loss",))
+    return register_layer_output(name, fl.mean(x=out))

@@ -40,6 +40,28 @@ def test_eager_profiler_per_op_table(capsys):
     assert any("mean" in k for k in records), records
 
 
+def test_jit_profiler_per_segment_table(capsys):
+    """Compiled path: one timed row per XLA segment, with the
+    trace/compile call split out from steady-state rows
+    (reference ParseEvents analog: platform/profiler.h:133-146)."""
+    x, out = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), np.float32)}
+    with fluid.profiler.profiler():
+        for _ in range(3):
+            exe.run(fluid.default_main_program(), feed=feed,
+                    fetch_list=[out])
+    printed = capsys.readouterr().out
+    assert "jit_segment[" in printed
+    records = fluid.profiler.get_profile_records()
+    seg_rows = {k: v for k, v in records.items() if "jit_segment" in k}
+    assert any(k.endswith("/first(trace)") for k in seg_rows), seg_rows
+    steady = [v for k, v in seg_rows.items()
+              if not k.endswith("/first(trace)")]
+    assert steady and steady[0]["calls"] == 2, seg_rows
+
+
 def test_check_nan_inf_flag():
     x = fluid.layers.data(name="x", shape=[2], dtype="float32")
     y = fluid.layers.log(x)  # log(-1) -> nan

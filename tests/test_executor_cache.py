@@ -1,0 +1,53 @@
+"""Executor program-cache keying: tokens must never alias across
+program lifetimes (id() can be reused after GC; reference executors
+key on the C++ ProgramDesc identity which has the same hazard)."""
+
+import gc
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def _build_and_run(exe, scale):
+    """Fresh program computing x * scale; same topology/version for
+    every scale so only the cache token distinguishes them."""
+    prog = framework.Program()
+    startup = framework.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.scale(x=x, scale=float(scale))
+    out, = exe.run(prog, feed={"x": np.ones((1, 4), np.float32)},
+                   fetch_list=[y])
+    return float(np.asarray(out).reshape(-1)[0])
+
+
+def test_program_tokens_unique_across_gc():
+    tokens = set()
+    for _ in range(50):
+        p = framework.Program()
+        assert p._cache_token not in tokens
+        tokens.add(p._cache_token)
+        del p
+        gc.collect()
+
+
+def test_no_stale_cache_hit_after_program_rebuild():
+    exe = fluid.Executor(fluid.CPUPlace())
+    # interleave builds and drops so CPython is free to reuse object
+    # ids; results must always track the live program's computation
+    for scale in (2.0, 3.0, 5.0, 7.0):
+        got = _build_and_run(exe, scale)
+        assert got == scale, (got, scale)
+        gc.collect()
+
+
+def test_clone_gets_its_own_cache_slot():
+    prog = framework.Program()
+    startup = framework.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.scale(x=x, scale=2.0)
+    clone = prog.clone()
+    assert clone._cache_token != prog._cache_token
