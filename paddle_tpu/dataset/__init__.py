@@ -3,11 +3,15 @@
 reference: python/paddle/v2/dataset/ (mnist, cifar, imdb, uci_housing,
 imikolov, movielens, conll05, sentiment, wmt14/16...).
 
-This build runs in an offline environment (zero egress), so each dataset
-is a *deterministic synthetic stand-in* with the exact shapes, dtypes and
-reader API of the original — enough for training-loop, convergence-trend
-and benchmark tests.  Swap in the real loaders by dropping files into
-`~/.cache/paddle_tpu/dataset/` (same layout the reference downloads)."""
+mnist (idx), cifar (pickled-batch tar), imdb (aclImdb tar) and conll05
+(column files) carry REAL parsers: they download into
+`~/.cache/paddle_tpu/dataset/` when the network allows (md5-checked,
+common.py) and accept explicit file paths.  When neither is available
+(this build is zero-egress) every dataset falls back to a
+*deterministic synthetic stand-in* with the exact shapes, dtypes and
+reader API of the original — enough for training-loop,
+convergence-trend and benchmark tests.  Network fetches are opt-in:
+set PADDLE_TPU_ALLOW_DOWNLOAD=1 to download."""
 
 from . import uci_housing  # noqa: F401
 from . import mnist        # noqa: F401

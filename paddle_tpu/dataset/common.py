@@ -1,10 +1,76 @@
-"""Shared synthetic-dataset helpers (reference: python/paddle/v2/dataset/
-common.py — download/md5 cache; here: deterministic generators)."""
+"""Shared dataset machinery: download cache, checksums, and synthetic
+fallback generators.
+
+Capability parity with the reference's dataset plumbing (reference:
+python/paddle/v2/dataset/common.py — DATA_HOME, md5-checked download).
+Real parsers live in the per-dataset modules; every module keeps a
+deterministic synthetic generator as an offline fallback so training
+examples and CI run with zero egress.
+"""
+
+import hashlib
+import os
 
 import numpy as np
 
-__all__ = ["rng", "synthetic_linear", "synthetic_images",
+__all__ = ["DATA_HOME", "md5file", "download", "fetch_or_none",
+           "rng", "synthetic_linear", "synthetic_images",
            "synthetic_sequences"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(path):
+    digest = hashlib.md5()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 16), b""):
+            digest.update(block)
+    return digest.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Fetch `url` into DATA_HOME/<module>/ once; verify md5 when given.
+
+    Raises on network failure — use :func:`fetch_or_none` for the
+    fallback-aware path."""
+    cache_dir = os.path.join(DATA_HOME, module_name)
+    os.makedirs(cache_dir, exist_ok=True)
+    filename = os.path.join(cache_dir,
+                            save_name or url.rstrip("/").split("/")[-1])
+    if not (os.path.exists(filename)
+            and (md5sum is None or md5file(filename) == md5sum)):
+        from urllib.request import urlopen
+
+        tmp = filename + ".part"
+        with urlopen(url, timeout=30) as resp, open(tmp, "wb") as out:
+            for block in iter(lambda: resp.read(1 << 16), b""):
+                out.write(block)
+        if md5sum is not None and md5file(tmp) != md5sum:
+            os.remove(tmp)
+            raise IOError("md5 mismatch for %s" % url)
+        os.replace(tmp, filename)
+    return filename
+
+
+def fetch_or_none(url, module_name, md5sum=None):
+    """Cached file if present, else None — the caller then uses its
+    synthetic fallback.  Network fetches are OPT-IN via
+    PADDLE_TPU_ALLOW_DOWNLOAD=1: a dataset call must never surprise a
+    unit test with an 80MB download (or a resolver hang in a
+    blackholed-egress environment; getaddrinfo ignores urlopen's
+    timeout)."""
+    allow_net = os.environ.get("PADDLE_TPU_ALLOW_DOWNLOAD") == "1" \
+        and not os.environ.get("PADDLE_TPU_OFFLINE")
+    if not allow_net:
+        cached = os.path.join(DATA_HOME, module_name,
+                              url.rstrip("/").split("/")[-1])
+        return cached if os.path.exists(cached) else None
+    try:
+        return download(url, module_name, md5sum)
+    except Exception:
+        return None
 
 
 def rng(seed):

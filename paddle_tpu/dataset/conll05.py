@@ -1,49 +1,194 @@
-"""CoNLL-2005 SRL stand-in (reference: python/paddle/v2/dataset/conll05.py
-— 8 feature sequences + BIO label sequence)."""
+"""CoNLL-2005 SRL: real column-format parsing with synthetic fallback.
 
-from .common import rng
+reference: python/paddle/v2/dataset/conll05.py — the corpus is a pair
+of gzipped column files (words: one token per line, blank line ends a
+sentence; props: predicate lemma + one bracket-tag column per
+predicate).  Bracket tags like '(A0*', '*', '*)' convert to BIO; each
+(sentence, predicate) pair yields the 8 feature sequences + label
+sequence the SRL model consumes.
+"""
 
-__all__ = ["get_dict", "get_embedding", "test"]
+import gzip
+import os
 
-_WORDS = 4000
-_PREDS = 300
-_LABELS = 59  # BIO over roles
+from .common import fetch_or_none, rng
+
+__all__ = ["get_dict", "get_embedding", "test", "parse_corpus",
+           "reader_creator", "load_dict"]
+
+DATA_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+            "conll05st-tests.tar.gz")
+DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+WORDDICT_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+                "wordDict.txt")
+WORDDICT_MD5 = "ea7fb7d4c75cc6254716f0177a506baa"
+VERBDICT_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+                "verbDict.txt")
+VERBDICT_MD5 = "0d2977293bbb6cbefab5b0f97db1e77c"
+TRGDICT_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+               "targetDict.txt")
+TRGDICT_MD5 = "d8c7f03ceb5fc2e5a0fa7503a4353751"
+
+UNK_IDX = 0
+
+_SYNTH_WORDS = 4000
+_SYNTH_PREDS = 300
+_SYNTH_LABELS = 59
 
 
-def get_dict():
-    word_dict = {("w%d" % i): i for i in range(_WORDS)}
-    verb_dict = {("v%d" % i): i for i in range(_PREDS)}
-    label_dict = {("l%d" % i): i for i in range(_LABELS)}
+def _open_text(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8")
+    return open(path, "r", encoding="utf-8")
+
+
+def _brackets_to_bio(tags):
+    """One predicate's bracket column -> BIO labels (reference
+    conll05.py corpus_reader inner loop: '(A0*' opens, '*)' closes,
+    bare '*' continues inside a span or emits O outside one)."""
+    bio = []
+    current = "O"
+    inside = False
+    for t in tags:
+        if t == "*":
+            bio.append("I-" + current if inside else "O")
+        elif t == "*)":
+            bio.append("I-" + current)
+            inside = False
+        elif "(" in t:
+            current = t[1:t.index("*")]
+            bio.append("B-" + current)
+            inside = ")" not in t
+        else:
+            raise ValueError("unexpected conll05 tag %r" % t)
+    return bio
+
+
+def parse_corpus(words_path, props_path):
+    """Yield (words, predicate, bio_labels) per (sentence, predicate)."""
+
+    def emit(words, prop_rows):
+        predicates = [r[0] for r in prop_rows if r[0] != "-"]
+        n_preds = len(prop_rows[0]) - 1
+        for k in range(n_preds):
+            tags = [r[k + 1] for r in prop_rows]
+            yield list(words), predicates[k], _brackets_to_bio(tags)
+
+    def corpus():
+        from itertools import zip_longest
+
+        with _open_text(words_path) as wf, _open_text(props_path) as pf:
+            words, prop_rows = [], []
+            for wline, pline in zip_longest(wf, pf):
+                if wline is None or pline is None:
+                    raise ValueError(
+                        "conll05: words/props files have different "
+                        "lengths (%s vs %s)" % (words_path, props_path))
+                word = wline.strip()
+                cols = pline.strip().split()
+                if cols:
+                    words.append(word)
+                    prop_rows.append(cols)
+                    continue
+                if prop_rows:  # blank line ends a sentence
+                    yield from emit(words, prop_rows)
+                words, prop_rows = [], []
+            if prop_rows:  # no trailing blank line after last sentence
+                yield from emit(words, prop_rows)
+
+    return corpus
+
+
+def reader_creator(corpus_reader, word_dict, verb_dict, label_dict):
+    """The 9-slot SRL sample (reference conll05.py reader_creator):
+    words, 5 predicate-context features, predicate, mark, labels."""
+
+    def context(words, i, fallback):
+        return words[i] if 0 <= i < len(words) else fallback
+
+    def reader():
+        for words, predicate, labels in corpus_reader():
+            n = len(words)
+            v = labels.index("B-V")
+            # the reference marks the 5-token window around the verb
+            mark = [0] * n
+            for off in (-2, -1, 0, 1, 2):
+                if 0 <= v + off < n:
+                    mark[v + off] = 1
+
+            def ids(tokens):
+                return [word_dict.get(t, UNK_IDX) for t in tokens]
+
+            ctx = {off: context(words, v + off,
+                                "bos" if off < 0 else "eos")
+                   for off in (-2, -1, 0, 1, 2)}
+            yield (ids(words),
+                   [word_dict.get(ctx[-2], UNK_IDX)] * n,
+                   [word_dict.get(ctx[-1], UNK_IDX)] * n,
+                   [word_dict.get(ctx[0], UNK_IDX)] * n,
+                   [word_dict.get(ctx[1], UNK_IDX)] * n,
+                   [word_dict.get(ctx[2], UNK_IDX)] * n,
+                   [verb_dict.get(predicate, UNK_IDX)] * n,
+                   mark,
+                   [label_dict[l] for l in labels])
+
+    return reader
+
+
+def load_dict(path):
+    """One entry per line -> {entry: line_no}."""
+    with _open_text(path) as f:
+        return {line.strip(): i for i, line in enumerate(f)}
+
+
+def _synthetic_dicts():
+    word_dict = {("w%d" % i): i for i in range(_SYNTH_WORDS)}
+    verb_dict = {("v%d" % i): i for i in range(_SYNTH_PREDS)}
+    label_dict = {("l%d" % i): i for i in range(_SYNTH_LABELS)}
     return word_dict, verb_dict, label_dict
 
 
+def get_dict():
+    paths = [fetch_or_none(u, "conll05st", m) for u, m in
+             ((WORDDICT_URL, WORDDICT_MD5), (VERBDICT_URL, VERBDICT_MD5),
+              (TRGDICT_URL, TRGDICT_MD5))]
+    if all(p and os.path.exists(p) for p in paths):
+        return tuple(load_dict(p) for p in paths)
+    return _synthetic_dicts()
+
+
 def get_embedding():
-    import numpy as np
+    return rng(33).uniform(-1, 1,
+                           size=(_SYNTH_WORDS, 32)).astype("float32")
 
-    return rng(33).uniform(-1, 1, size=(_WORDS, 32)).astype("float32")
 
-
-def _reader(n, seed):
+def _synthetic_reader(n, seed):
     r = rng(seed)
 
     def reader():
         for _ in range(n):
             length = int(r.randint(5, 35))
-            word = r.randint(0, _WORDS, size=length).tolist()
+            word = r.randint(0, _SYNTH_WORDS, size=length).tolist()
             pred_idx = int(r.randint(0, length))
-            predicate = [int(r.randint(0, _PREDS))] * length
+            predicate = [int(r.randint(0, _SYNTH_PREDS))] * length
             ctx_n2 = word[max(0, pred_idx - 2):][:1] * length
             ctx_n1 = word[max(0, pred_idx - 1):][:1] * length
             ctx_0 = [word[pred_idx]] * length
             ctx_p1 = word[min(length - 1, pred_idx + 1):][:1] * length
             ctx_p2 = word[min(length - 1, pred_idx + 2):][:1] * length
             mark = [1 if i == pred_idx else 0 for i in range(length)]
-            label = r.randint(0, _LABELS, size=length).tolist()
-            yield (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate,
-                   mark, label)
+            label = r.randint(0, _SYNTH_LABELS, size=length).tolist()
+            yield (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+                   predicate, mark, label)
 
     return reader
 
 
-def test():
-    return _reader(256, 44)
+def test(words_path=None, props_path=None, dicts=None):
+    """Real column files when given/downloadable; synthetic otherwise."""
+    if words_path and props_path and os.path.exists(words_path) \
+            and os.path.exists(props_path):
+        word_dict, verb_dict, label_dict = dicts or get_dict()
+        return reader_creator(parse_corpus(words_path, props_path),
+                              word_dict, verb_dict, label_dict)
+    return _synthetic_reader(256, 44)
