@@ -70,8 +70,13 @@ def _synthetic_reader(n, classes, seed):
 
 
 def _make(url, md5, marker, classes, synth_n, seed, tar_path=None):
-    if tar_path is None:
-        tar_path = fetch_or_none(url, "cifar", md5)
+    if tar_path is not None:
+        # an explicit path must exist — silently training on synthetic
+        # data because of a typo would be worse than failing
+        if not os.path.exists(tar_path):
+            raise FileNotFoundError("cifar: %r does not exist" % tar_path)
+        return reader_creator(tar_path, marker)
+    tar_path = fetch_or_none(url, "cifar", md5)
     if tar_path and os.path.exists(tar_path):
         return reader_creator(tar_path, marker)
     return _synthetic_reader(synth_n, classes, seed)

@@ -157,9 +157,27 @@ def get_dict():
     return _synthetic_dicts()
 
 
-def get_embedding():
-    return rng(33).uniform(-1, 1,
-                           size=(_SYNTH_WORDS, 32)).astype("float32")
+def build_dicts_from_corpus(corpus_reader):
+    """Derive (word, verb, label) dicts from a corpus — the offline
+    analog of the reference's downloaded wordDict/verbDict/targetDict
+    for user-supplied column files."""
+    words, verbs, labels = set(), set(), set()
+    for sent, verb, bio in corpus_reader():
+        words.update(sent)
+        verbs.add(verb)
+        labels.update(bio)
+    words |= {"bos", "eos"}
+    return ({w: i for i, w in enumerate(sorted(words))},
+            {v: i for i, v in enumerate(sorted(verbs))},
+            {l: i for i, l in enumerate(sorted(labels))})
+
+
+def get_embedding(word_dict=None, dim=32):
+    """Random embedding sized to the dict (the reference downloads a
+    trained Wikipedia table; offline a deterministic random one with
+    the right row count keeps models shape-correct)."""
+    rows = len(word_dict) if word_dict is not None else _SYNTH_WORDS
+    return rng(33).uniform(-1, 1, size=(rows, dim)).astype("float32")
 
 
 def _synthetic_reader(n, seed):
@@ -184,11 +202,50 @@ def _synthetic_reader(n, seed):
     return reader
 
 
+def _extracted_corpus_paths():
+    """Download + extract the official test tarball when allowed;
+    returns (words_path, props_path) or None."""
+    tar_path = fetch_or_none(DATA_URL, "conll05st", DATA_MD5)
+    if not tar_path or not os.path.exists(tar_path):
+        return None
+    import tarfile
+
+    root = os.path.dirname(tar_path)
+    words = os.path.join(root, "conll05st-release/test.wsj/words/"
+                               "test.wsj.words.gz")
+    props = os.path.join(root, "conll05st-release/test.wsj/props/"
+                               "test.wsj.props.gz")
+    if not (os.path.exists(words) and os.path.exists(props)):
+        with tarfile.open(tar_path) as tf:
+            tf.extractall(root)
+    if os.path.exists(words) and os.path.exists(props):
+        return words, props
+    return None
+
+
 def test(words_path=None, props_path=None, dicts=None):
-    """Real column files when given/downloadable; synthetic otherwise."""
-    if words_path and props_path and os.path.exists(words_path) \
-            and os.path.exists(props_path):
-        word_dict, verb_dict, label_dict = dicts or get_dict()
-        return reader_creator(parse_corpus(words_path, props_path),
-                              word_dict, verb_dict, label_dict)
+    """Real column files (explicit paths, or the downloaded official
+    tarball when PADDLE_TPU_ALLOW_DOWNLOAD=1); synthetic otherwise.
+    Without `dicts`, dictionaries come from the downloaded dict files
+    or are derived from the corpus itself."""
+    explicit = words_path is not None or props_path is not None
+    if explicit:
+        for p in (words_path, props_path):
+            if not p or not os.path.exists(p):
+                raise FileNotFoundError(
+                    "conll05: explicit corpus path %r does not exist"
+                    % (p,))
+    else:
+        found = _extracted_corpus_paths()
+        if found:
+            words_path, props_path = found
+    if words_path and props_path:
+        corpus = parse_corpus(words_path, props_path)
+        if dicts is None:
+            if explicit:
+                dicts = build_dicts_from_corpus(corpus)
+            else:
+                dicts = get_dict()
+        word_dict, verb_dict, label_dict = dicts
+        return reader_creator(corpus, word_dict, verb_dict, label_dict)
     return _synthetic_reader(256, 44)

@@ -82,20 +82,31 @@ def _synthetic_reader(n, seed):
 
 
 def _tar_or_none(tar_path):
-    if tar_path is None:
-        tar_path = fetch_or_none(URL, "imdb", MD5)
+    if tar_path is not None:
+        if not os.path.exists(tar_path):
+            raise FileNotFoundError("imdb: %r does not exist" % tar_path)
+        return tar_path
+    tar_path = fetch_or_none(URL, "imdb", MD5)
     if tar_path and os.path.exists(tar_path):
         return tar_path
     return None
+
+
+# full-corpus dict builds are a sequential scan of the whole tarball;
+# memoize per (path, mtime) so train()+test() share one scan
+_dict_cache = {}
 
 
 def word_dict(tar_path=None, cutoff=150):
     """reference: imdb.py word_dict() — dict over the whole corpus."""
     tar_path = _tar_or_none(tar_path)
     if tar_path:
-        return build_dict(tar_path,
-                          re.compile(r"aclImdb/((train)|(test))/"
+        key = (tar_path, os.path.getmtime(tar_path), cutoff)
+        if key not in _dict_cache:
+            _dict_cache[key] = build_dict(
+                tar_path, re.compile(r"aclImdb/((train)|(test))/"
                                      r"((pos)|(neg))/.*\.txt$"), cutoff)
+        return _dict_cache[key]
     return {("w%d" % i): i for i in range(_SYNTH_VOCAB)}
 
 

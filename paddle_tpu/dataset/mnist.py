@@ -85,10 +85,20 @@ def _synthetic_reader(n, seed):
 
 def _make(image_url, image_md5, label_url, label_md5, synth_n, seed,
           image_path=None, label_path=None):
+    explicit = image_path is not None or label_path is not None
     if image_path is None:
         image_path = fetch_or_none(image_url, "mnist", image_md5)
     if label_path is None:
         label_path = fetch_or_none(label_url, "mnist", label_md5)
+    if explicit:
+        # explicit paths must both resolve — never silently swap a
+        # user-supplied file for synthetic data
+        for p in (image_path, label_path):
+            if not p or not os.path.exists(p):
+                raise FileNotFoundError(
+                    "mnist: %r does not exist (explicit paths require "
+                    "both image and label files)" % (p,))
+        return reader_creator(image_path, label_path)
     if image_path and label_path and os.path.exists(image_path) \
             and os.path.exists(label_path):
         return reader_creator(image_path, label_path)

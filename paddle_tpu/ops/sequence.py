@@ -529,3 +529,54 @@ def _dense_to_sequence_infer(block, op_desc):
 
 
 _gi_seq("dense_to_sequence").infer_shape = _dense_to_sequence_infer
+
+
+# -- nested (lod_level 2) sequence machinery ---------------------------------
+# The RecurrentGradientMachine's nested-sequence mode (reference:
+# RecurrentGradientMachine.h:32, layers.py SubsequenceInput:4067) is
+# lowered by FLATTENING: the outer "loop over subsequences" becomes a
+# batch axis (every inner sequence is an independent lod-1 sequence),
+# computation runs once over the whole sentence batch, and the outer
+# row_splits are reattached afterwards.  All three ops are pure splits
+# bookkeeping -- jittable, differentiable pass-throughs for the values.
+
+@register_op("seq_unnest")
+def seq_unnest(ctx, ins, attrs):
+    """lod-2 nested sequence -> (lod-1 batch of inner sequences,
+    OuterRef carrying the dropped outer row_splits over inner rows)."""
+    x = ins["X"][0]
+    if not isinstance(x, RaggedTensor) or x.lod_level < 2:
+        raise ValueError("seq_unnest needs a lod_level-2 input")
+    outer, inner = x.row_splits[0], x.row_splits[-1]
+    n_inner = inner.shape[0] - 1
+    inner_batch = RaggedTensor(x.values, [inner], x.nvalid)
+    outer_ref = RaggedTensor(jnp.zeros((n_inner, 1), jnp.float32),
+                             [outer], n_inner)
+    return {"Inner": [inner_batch], "OuterRef": [outer_ref]}
+
+
+@register_op("seq_outer_expand", nondiff_inputs=("OuterRef",))
+def seq_outer_expand(ctx, ins, attrs):
+    """Tile per-sample rows to per-inner-sequence rows: out[s] =
+    X[sample_of(s)] -- the flattened analog of a StaticInput entering
+    every outer step."""
+    x = ins["X"][0]
+    ref = ins["OuterRef"][0]
+    xv = x.values if isinstance(x, RaggedTensor) else x
+    seg = ref.segment_ids(level=-1)
+    return {"Out": [xv[seg]]}
+
+
+@register_op("seq_renest", nondiff_inputs=("OuterRef",))
+def seq_renest(ctx, ins, attrs):
+    """Reattach the outer row_splits to a flattened result.  Dense
+    [n_inner, D] rows -> lod-1 sequence over samples; a lod-1 ragged
+    (per-inner-sequence steps) -> the full lod-2 nested sequence."""
+    x = ins["X"][0]
+    ref = ins["OuterRef"][0]
+    outer = ref.row_splits[0]
+    if isinstance(x, RaggedTensor):
+        return {"Out": [RaggedTensor(x.values,
+                                     [outer, x.last_splits()],
+                                     x.nvalid)]}
+    return {"Out": [RaggedTensor(x, [outer])]}

@@ -3,8 +3,9 @@ over paddle/trainer/PyDataProviderWrapper InputType)."""
 
 __all__ = [
     "dense_vector", "dense_array", "dense_vector_sequence",
-    "integer_value", "integer_value_sequence", "sparse_binary_vector",
-    "sparse_float_vector", "InputType",
+    "dense_vector_sub_sequence", "integer_value",
+    "integer_value_sequence", "integer_value_sub_sequence",
+    "sparse_binary_vector", "sparse_float_vector", "InputType",
 ]
 
 
@@ -28,12 +29,22 @@ def dense_vector_sequence(dim):
     return InputType(dim, 1, "float32")
 
 
+def dense_vector_sub_sequence(dim):
+    """Nested sequence of dense vectors (reference: data_type.py
+    seq_type=2 — sequence of subsequences)."""
+    return InputType(dim, 2, "float32")
+
+
 def integer_value(value_range, seq_type=0):
     return InputType(value_range, seq_type, "int64", shape=[1])
 
 
 def integer_value_sequence(value_range):
     return InputType(value_range, 1, "int64", shape=[1])
+
+
+def integer_value_sub_sequence(value_range):
+    return InputType(value_range, 2, "int64", shape=[1])
 
 
 def sparse_binary_vector(dim, seq_type=0):

@@ -179,17 +179,99 @@ class _RecurrentGroup:
             self.drnn.update_memory(mem, target)
 
 
+class _NestedGroup:
+    """Group for the flattened nested-sequence path: every inner
+    sequence runs as an independent batch element, so there is no
+    cross-subsequence recurrence to carry."""
+
+    def add_memory(self, name, size, boot_layer=None,
+                   boot_with_const_id=None):
+        raise NotImplementedError(
+            "memory() across subsequences is not supported by the "
+            "flattened SubsequenceInput lowering; encode each "
+            "subsequence here, then run an ordinary recurrent_group "
+            "over the returned sentence-level sequence for the outer "
+            "recurrence")
+
+    def finalize(self):
+        pass
+
+
+def _nested_recurrent_group(step, inputs, name):
+    """SubsequenceInput lowering (reference nested-sequence mode:
+    RecurrentGradientMachine.h:32): unnest lod-2 inputs into a lod-1
+    batch of inner sequences, trace `step` ONCE over that batch (inner
+    recurrent_groups ride the normal lod-1 scan), and reattach the
+    outer row_splits to every output — dense per-subsequence rows
+    become a sentence-level sequence, sequence outputs become nested
+    again."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper(name or "nested_recurrent_group")
+    inners, outer_ref = {}, None
+    for idx, i in enumerate(inputs):
+        if not isinstance(i, _SubseqInput):
+            continue
+        x = i.input
+        if getattr(x, "lod_level", 0) < 2:
+            raise ValueError(
+                "SubsequenceInput needs a nested (lod_level 2) "
+                "sequence; got lod_level %d" % getattr(x, "lod_level", 0))
+        inner = helper.create_tmp_variable(x.dtype, lod_level=1)
+        oref = helper.create_tmp_variable("float32", lod_level=1)
+        helper.append_op(type="seq_unnest", inputs={"X": [x]},
+                         outputs={"Inner": [inner], "OuterRef": [oref]})
+        inners[idx] = inner
+        if outer_ref is None:
+            outer_ref = oref
+
+    args = []
+    for idx, i in enumerate(inputs):
+        if isinstance(i, _SubseqInput):
+            args.append(inners[idx])
+        elif isinstance(i, StaticInput):
+            if i.is_seq:
+                raise NotImplementedError(
+                    "StaticInput(is_seq=True) inside a nested group")
+            exp = helper.create_tmp_variable(i.input.dtype)
+            helper.append_op(type="seq_outer_expand",
+                             inputs={"X": [i.input],
+                                     "OuterRef": [outer_ref]},
+                             outputs={"Out": [exp]})
+            args.append(exp)
+        else:
+            raise ValueError(
+                "nested recurrent_group inputs must be SubsequenceInput "
+                "or StaticInput (got %r)" % (i,))
+
+    with _activate(_NestedGroup()):
+        outs = step(*args)
+    outs_list = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    results = []
+    for o in outs_list:
+        lod = 2 if getattr(o, "lod_level", 0) else 1
+        out = helper.create_tmp_variable(o.dtype, lod_level=lod)
+        helper.append_op(type="seq_renest",
+                         inputs={"X": [o], "OuterRef": [outer_ref]},
+                         outputs={"Out": [out]})
+        results.append(out)
+    return results[0] if len(results) == 1 else results
+
+
 def recurrent_group(step, input, reverse=False, name=None,
                     targetInlink=None):
     """Iterate `step` over the time steps of the sequence inputs
     (reference: layers.py recurrent_group:4082 over
     RecurrentGradientMachine).  Lowered to one masked lax.scan via
-    DynamicRNN; StaticInput vars enter the scan closure unchanged."""
+    DynamicRNN; StaticInput vars enter the scan closure unchanged.
+    With SubsequenceInput (nested lod-2) inputs the group flattens the
+    outer level into the batch instead (see _nested_recurrent_group);
+    `reverse` is identity there since the flattened form has no
+    cross-subsequence order dependence."""
     inputs = list(input) if isinstance(input, (list, tuple)) else [input]
     if any(isinstance(i, _SubseqInput) for i in inputs):
-        raise NotImplementedError(
-            "SubsequenceInput (nested sequence scatter) is not yet "
-            "supported; flatten with sequence ops instead")
+        return _nested_recurrent_group(step, inputs, name)
 
     # reverse inlinks before the scan; outputs un-reversed after
     prepared = []

@@ -119,8 +119,43 @@ def test_conll05_no_trailing_blank_and_mismatch(tmp_path):
         list(conll05.parse_corpus(str(words), str(short))())
 
 
-def test_synthetic_fallback_still_works():
-    # no paths, no network -> deterministic synthetic readers
+def test_explicit_missing_paths_raise(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        cifar.train10(tar_path=str(tmp_path / "nope.tar.gz"))
+    with pytest.raises(FileNotFoundError):
+        mnist.train(image_path=str(tmp_path / "imgs.gz"))
+    with pytest.raises(FileNotFoundError):
+        imdb.train(tar_path=str(tmp_path / "nope.tar.gz"))
+    with pytest.raises(FileNotFoundError):
+        conll05.test(words_path=str(tmp_path / "w.gz"),
+                     props_path=str(tmp_path / "p.gz"))
+
+
+def test_conll05_explicit_paths_derive_dicts():
+    """Real corpus + no dicts: dictionaries come from the corpus, and
+    get_embedding sizes to the dict."""
+    reader = conll05.test(
+        words_path=os.path.join(FX, "conll05_words.gz"),
+        props_path=os.path.join(FX, "conll05_props.gz"))
+    samples = list(reader())
+    assert len(samples) == 2 and len(samples[0]) == 9
+    corpus = conll05.parse_corpus(
+        os.path.join(FX, "conll05_words.gz"),
+        os.path.join(FX, "conll05_props.gz"))
+    wd, vd, ld = conll05.build_dicts_from_corpus(corpus)
+    emb = conll05.get_embedding(wd)
+    assert emb.shape == (len(wd), 32)
+
+
+def test_synthetic_fallback_still_works(monkeypatch, tmp_path):
+    # no paths, no network, and an empty isolated cache ->
+    # deterministic synthetic readers (a developer's populated
+    # ~/.cache must not change unit-test behavior)
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
     s = list(mnist.train()())
     assert len(s) == 2048 and s[0][0].shape == (784,)
     s = list(cifar.train10()())

@@ -276,3 +276,93 @@ def test_seqgen_train_then_decode():
             cur.append(w)
     # best beam must have learned the target: bos 2 3 eos
     assert seqs[0] == [0, 2, 3, 1], seqs
+
+
+# -- nested sequences (SubsequenceInput) -------------------------------------
+
+def test_nested_group_inner_accumulation():
+    """Outer=sentences, inner=words: the step runs an inner recurrence
+    per subsequence; outputs re-nest to lod 2 (reference:
+    RecurrentGradientMachine.h:32 nested mode)."""
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sub_sequence(2))
+
+    def outer_step(sent):
+        def inner_step(w):
+            mem = layer.memory(name="nacc", size=2)
+            out = layer.addto(input=[mem, w], act=None)
+            mem.set_input(out)
+            return out
+
+        return layer.recurrent_group(step=inner_step, input=sent)
+
+    out = layer.recurrent_group(step=outer_step,
+                                input=layer.SubsequenceInput(x))
+    # sample 0: 2 sentences; sample 1: 1 sentence
+    seqs = [[[[1, 0], [2, 0]], [[5, 0], [1, 0], [1, 0]]],
+            [[[7, 0]]]]
+    vals, lod = _run_seq(out, ["x"], {"x": seqs})
+    # prefix sums restart at every sentence
+    assert vals.tolist() == [[1, 0], [3, 0], [5, 0], [6, 0], [7, 0],
+                             [7, 0]]
+    assert lod[0] == [0, 2, 3]          # outer: sentences per sample
+    assert lod[-1] == [0, 2, 5, 6]      # inner: words per sentence
+
+
+def test_nested_group_sentence_encoder_trains():
+    """Hierarchical model: words->sentence encodings (nested group),
+    then an ordinary recurrent_group over sentences; trains end to
+    end."""
+    words = layer.data(name="words",
+                       type=v2.data_type.dense_vector_sub_sequence(4))
+    glob = layer.data(name="glob", type=v2.data_type.dense_vector(4))
+    label = layer.data(name="label", type=v2.data_type.dense_vector(1))
+
+    def encode_sentence(sent, g):
+        h = layer.fc(input=sent, size=6, act=v2.activation.Tanh())
+        h2 = layer.fc(input=g, size=6)  # expanded static, per sentence
+        enc = layer.last_seq(input=h)
+        return layer.addto(input=[enc, h2], act=None)
+
+    sent_seq = layer.recurrent_group(
+        step=encode_sentence,
+        input=[layer.SubsequenceInput(words),
+               layer.StaticInput(glob)])
+    doc = layer.last_seq(input=sent_seq)
+    pred = layer.fc(input=doc, size=1)
+    cost = layer.mse_cost(input=pred, label=label)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+
+    rs = np.random.RandomState(0)
+    docs = [[rs.rand(rs.randint(2, 5), 4).tolist()
+             for _ in range(rs.randint(1, 4))] for _ in range(6)]
+    globs = [rs.rand(4).tolist() for _ in range(6)]
+    labels = [[float(len(d))] for d in docs]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    blk = fluid.default_main_program().global_block()
+    feeder = fluid.DataFeeder(
+        place=fluid.CPUPlace(),
+        feed_list=[blk.var("words"), blk.var("glob"), blk.var("label")])
+    feeds = feeder.feed(list(zip(docs, globs, labels)))
+    losses = [float(np.asarray(exe.run(
+        fluid.default_main_program(), feed=feeds,
+        fetch_list=[cost])[0]).reshape(-1)[0]) for _ in range(10)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_nested_group_outer_memory_raises():
+    x = layer.data(name="x",
+                   type=v2.data_type.dense_vector_sub_sequence(2))
+
+    def outer_step(sent):
+        layer.memory(name="om", size=2)  # cross-subsequence state
+        return layer.last_seq(input=sent)
+
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="subsequence"):
+        layer.recurrent_group(step=outer_step,
+                              input=layer.SubsequenceInput(x))
