@@ -22,13 +22,18 @@ extern "C" {
 /* ---- parameter server ------------------------------------------------ */
 /* sync=1: gradients barrier across num_trainers then one optimizer step
  * (reference: ParameterServer2 addGradient + synchronize barriers);
- * sync=0: apply each gradient immediately (reference: asyncSGD). */
-void *ptrt_pserver_start(int port, int num_trainers, int sync);
+ * sync=0: apply each gradient immediately (reference: asyncSGD).
+ * async_lagged > 0 discards async gradients computed against parameters
+ * more than that many versions old (reference: ParameterServer2.h:243
+ * lagged-async commit control); 0 = unbounded. */
+void *ptrt_pserver_start(int port, int num_trainers, int sync,
+                         int async_lagged);
 void ptrt_pserver_stop(void *s);
 int ptrt_pserver_port(void *s);      /* bound port (0 -> ephemeral) */
 int ptrt_pserver_save(void *s, const char *path);  /* checkpoint w/ crc */
 int ptrt_pserver_load(void *s, const char *path);
 int64_t ptrt_pserver_num_updates(void *s);
+int64_t ptrt_pserver_num_lagged(void *s);  /* staleness-discarded count */
 
 /* ---- pserver client -------------------------------------------------- */
 void *ptrt_client_connect(const char *host, int port);
@@ -39,11 +44,16 @@ int ptrt_client_init_param(void *c, const char *name, const float *data,
                            int64_t n, int opt_kind, double lr,
                            double hp1, double hp2, double hp3);
 /* blocking: returns after the server applied the (sync: aggregated)
- * update; out receives the fresh parameter (may be NULL). */
+ * update; out receives the fresh parameter (may be NULL).
+ * base_version: the parameter version the gradient was computed
+ * against (from a prior send_grad/get_param); new_version (may be
+ * NULL) receives the server's version.  Returns 4 when the gradient
+ * was discarded as stale — out is still the fresh parameter. */
 int ptrt_client_send_grad(void *c, const char *name, const float *grad,
-                          int64_t n, float *out);
+                          int64_t n, float *out, int64_t base_version,
+                          int64_t *new_version);
 int ptrt_client_get_param(void *c, const char *name, float *out,
-                          int64_t n);
+                          int64_t n, int64_t *version);
 /* sparse rows (reference: getParameterSparse / SelectedRows path) */
 int ptrt_client_send_sparse_grad(void *c, const char *name,
                                  const int32_t *rows, const float *vals,

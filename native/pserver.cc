@@ -42,8 +42,9 @@ struct ParamEntry {
 
 class PServer {
  public:
-  PServer(int port, int num_trainers, int sync)
+  PServer(int port, int num_trainers, int sync, int async_lagged)
       : num_trainers_(num_trainers), sync_(sync),
+        async_lagged_(async_lagged),
         server_(port, [this](uint32_t op, Reader &r, Writer &w) {
           handle(op, r, w);
         }) {}
@@ -60,6 +61,11 @@ class PServer {
     cv_.notify_all();
     server_.stop();
   }
+  int64_t numLagged() {
+    std::lock_guard<std::mutex> g(mu_);
+    return lagged_grads_;
+  }
+
   int64_t numUpdates() {
     std::lock_guard<std::mutex> g(mu_);
     return updates_;
@@ -163,6 +169,7 @@ class PServer {
       }
       case kSendGrad: {
         std::string name = r.str();
+        int64_t base_version = r.i64();
         uint64_t len;
         const uint8_t *data = r.blob(&len);
         std::unique_lock<std::mutex> g(mu_);
@@ -173,6 +180,21 @@ class PServer {
         size_t n = len / 4;
         if (n != e.value.size()) { w.u32(2); return; }
         if (!sync_ || num_trainers_ <= 1) {
+          // async staleness bound (reference: ParameterServer2.cpp:416
+          // asyncGrdientCommitCheckAndStat over
+          // FLAGS_async_lagged_grad_discard_ratio,
+          // ParameterServer2.h:243): a gradient computed against
+          // parameters more than async_lagged_ versions old is
+          // discarded; the trainer still receives the fresh value so
+          // it resynchronizes instead of looping on stale state.
+          if (!sync_ && async_lagged_ > 0 &&
+              e.version - base_version >= async_lagged_) {
+            lagged_grads_++;
+            w.u32(4);
+            w.i64(e.version);
+            w.bytes(e.value.data(), e.value.size() * 4);
+            return;
+          }
           e.opt.step++;
           e.opt.apply(e.value.data(), grad, 0, n);
           e.version++;
@@ -202,6 +224,7 @@ class PServer {
           }
         }
         w.u32(0);
+        w.i64(e.version);
         w.bytes(e.value.data(), e.value.size() * 4);
         break;
       }
@@ -211,6 +234,7 @@ class PServer {
         auto it = params_.find(name);
         if (it == params_.end()) { w.u32(1); return; }
         w.u32(0);
+        w.i64(it->second.version);
         w.bytes(it->second.value.data(), it->second.value.size() * 4);
         break;
       }
@@ -305,6 +329,8 @@ class PServer {
 
   int num_trainers_;
   int sync_;
+  int async_lagged_ = 0;       // 0 = unbounded (legacy behavior)
+  int64_t lagged_grads_ = 0;   // discarded-as-stale count
   bool stopping_ = false;
   std::mutex mu_;
   std::condition_variable cv_;
@@ -319,8 +345,9 @@ class PServer {
 
 extern "C" {
 
-void *ptrt_pserver_start(int port, int num_trainers, int sync) {
-  return new PServer(port, num_trainers, sync);
+void *ptrt_pserver_start(int port, int num_trainers, int sync,
+                         int async_lagged) {
+  return new PServer(port, num_trainers, sync, async_lagged);
 }
 void ptrt_pserver_stop(void *s) {
   PServer *p = static_cast<PServer *>(s);
@@ -336,6 +363,9 @@ int ptrt_pserver_load(void *s, const char *path) {
 }
 int64_t ptrt_pserver_num_updates(void *s) {
   return static_cast<PServer *>(s)->numUpdates();
+}
+int64_t ptrt_pserver_num_lagged(void *s) {
+  return static_cast<PServer *>(s)->numLagged();
 }
 
 void *ptrt_client_connect(const char *host, int port) {
@@ -366,34 +396,45 @@ int ptrt_client_init_param(void *c, const char *name, const float *data,
 }
 
 int ptrt_client_send_grad(void *c, const char *name, const float *grad,
-                          int64_t n, float *out) {
+                          int64_t n, float *out, int64_t base_version,
+                          int64_t *new_version) {
   Writer w;
   w.str(name);
+  w.i64(base_version);
   w.bytes(grad, static_cast<size_t>(n) * 4);
   std::vector<uint8_t> resp;
   if (!static_cast<Client *>(c)->call(kSendGrad, w, &resp)) return -1;
   Reader r(resp.data(), resp.size());
   int rc = static_cast<int>(r.u32());
-  if (rc == 0 && out) {
-    uint64_t len;
-    const uint8_t *v = r.blob(&len);
-    memcpy(out, v, std::min<uint64_t>(len, static_cast<uint64_t>(n) * 4));
+  // rc 4 = discarded as stale; the fresh parameter still follows
+  if ((rc == 0 || rc == 4)) {
+    int64_t ver = r.i64();
+    if (new_version) *new_version = ver;
+    if (out) {
+      uint64_t len;
+      const uint8_t *v = r.blob(&len);
+      memcpy(out, v, std::min<uint64_t>(len, static_cast<uint64_t>(n) * 4));
+    }
   }
   return rc;
 }
 
 int ptrt_client_get_param(void *c, const char *name, float *out,
-                          int64_t n) {
+                          int64_t n, int64_t *version) {
   Writer w;
   w.str(name);
   std::vector<uint8_t> resp;
   if (!static_cast<Client *>(c)->call(kGetParam, w, &resp)) return -1;
   Reader r(resp.data(), resp.size());
   int rc = static_cast<int>(r.u32());
-  if (rc == 0 && out) {
-    uint64_t len;
-    const uint8_t *v = r.blob(&len);
-    memcpy(out, v, std::min<uint64_t>(len, static_cast<uint64_t>(n) * 4));
+  if (rc == 0) {
+    int64_t ver = r.i64();
+    if (version) *version = ver;
+    if (out) {
+      uint64_t len;
+      const uint8_t *v = r.blob(&len);
+      memcpy(out, v, std::min<uint64_t>(len, static_cast<uint64_t>(n) * 4));
+    }
   }
   return rc;
 }

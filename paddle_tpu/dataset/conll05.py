@@ -217,7 +217,10 @@ def _extracted_corpus_paths():
                                "test.wsj.props.gz")
     if not (os.path.exists(words) and os.path.exists(props)):
         with tarfile.open(tar_path) as tf:
-            tf.extractall(root)
+            try:
+                tf.extractall(root, filter="data")  # no ../ escapes
+            except TypeError:  # filter= requires python >= 3.11.4
+                tf.extractall(root)
     if os.path.exists(words) and os.path.exists(props):
         return words, props
     return None
@@ -242,10 +245,17 @@ def test(words_path=None, props_path=None, dicts=None):
     if words_path and props_path:
         corpus = parse_corpus(words_path, props_path)
         if dicts is None:
-            if explicit:
-                dicts = build_dicts_from_corpus(corpus)
+            # real corpus must never pair with the synthetic dict
+            # fallback (its keys aren't BIO tags -> KeyError mid-read);
+            # derive from the corpus unless the real dict files exist
+            paths = [fetch_or_none(u, "conll05st", m) for u, m in
+                     ((WORDDICT_URL, WORDDICT_MD5),
+                      (VERBDICT_URL, VERBDICT_MD5),
+                      (TRGDICT_URL, TRGDICT_MD5))]
+            if all(p and os.path.exists(p) for p in paths):
+                dicts = tuple(load_dict(p) for p in paths)
             else:
-                dicts = get_dict()
+                dicts = build_dicts_from_corpus(corpus)
         word_dict, verb_dict, label_dict = dicts
         return reader_creator(corpus, word_dict, verb_dict, label_dict)
     return _synthetic_reader(256, 44)

@@ -133,22 +133,79 @@ def get_inference_program(target_vars, main_program=None):
     return prune_program(main_program, target_vars)
 
 
+def _op_block_refs(op):
+    """Sub-block indices referenced from an op's attrs."""
+    from ..core.desc import BlockRef
+
+    refs = []
+    for v in op.attrs.values():
+        if isinstance(v, BlockRef):
+            refs.append(v.idx)
+        elif isinstance(v, (list, tuple)):
+            refs.extend(x.idx for x in v if isinstance(x, BlockRef))
+    return refs
+
+
+def _closure_reads(desc, block_idx, memo):
+    """Every name a block tree reads before writing it — the closure a
+    parent must keep alive when it keeps the owning op.  Control-flow
+    builders list closures in op inputs already; this recursion is the
+    safety net for any op that doesn't."""
+    if block_idx in memo:
+        return memo[block_idx]
+    bd = desc.block(block_idx)
+    reads, writes = set(), set()
+    for op in bd.ops:
+        for n in op.input_names():
+            if n != "@EMPTY@" and n not in writes:
+                reads.add(n)
+        for sub in _op_block_refs(op):
+            reads |= (_closure_reads(desc, sub, memo) - writes)
+        writes.update(op.output_names())
+    memo[block_idx] = {n for n in reads if n not in bd.vars}
+    return memo[block_idx]
+
+
 def prune_program(program, targets):
-    """Prune ops not needed for `targets` (reference:
-    framework/prune.cc:108 + Program.prune)."""
+    """Prune block-0 ops not needed for `targets`; a kept op keeps its
+    whole sub-block tree alive, including closure vars the sub-blocks
+    read from outer scope (reference: framework/prune.cc:108 recursing
+    the same way)."""
     target_names = {t.name if isinstance(t, Variable) else str(t)
                     for t in targets}
     pruned = program.clone(for_test=True)
-    block = pruned.desc.block(0)
+    desc = pruned.desc
+    block = desc.block(0)
     needed = set(target_names)
+    produced = set()
+    memo = {}
     keep = []
     for op in reversed(block.ops):
         if any(n in needed for n in op.output_names()):
             keep.append(op)
-            for n in op.input_names():
-                needed.add(n)
+            needed.update(n for n in op.input_names() if n != "@EMPTY@")
+            produced.update(op.output_names())
+            for sub in _op_block_refs(op):
+                needed |= _closure_reads(desc, sub, memo)
     block.ops = list(reversed(keep))
     pruned.blocks[0].sync_with_desc()
+
+    # every target must be reachable in the pruned block-0 graph — a
+    # target living only inside a sub-block would otherwise export an
+    # empty program that fails much later, at inference time
+    for name in target_names:
+        if name in produced:
+            continue
+        if block.has_var(name) and block.vars[name].persistable:
+            continue  # parameters are valid targets without an op
+        if not block.has_var(name):
+            raise ValueError(
+                "inference target %r is not a block-0 variable; fetch "
+                "a block-0 output (e.g. the recurrent group's result, "
+                "not a variable inside its step block)" % name)
+        raise ValueError(
+            "inference target %r is produced by no op (feed "
+            "variables cannot be targets)" % name)
     return pruned
 
 

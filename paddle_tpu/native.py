@@ -65,12 +65,14 @@ def lib():
         L = ctypes.CDLL(_SO_PATH)
         c = ctypes
         sigs = {
-            "ptrt_pserver_start": (c.c_void_p, [c.c_int, c.c_int, c.c_int]),
+            "ptrt_pserver_start":
+                (c.c_void_p, [c.c_int, c.c_int, c.c_int, c.c_int]),
             "ptrt_pserver_stop": (None, [c.c_void_p]),
             "ptrt_pserver_port": (c.c_int, [c.c_void_p]),
             "ptrt_pserver_save": (c.c_int, [c.c_void_p, c.c_char_p]),
             "ptrt_pserver_load": (c.c_int, [c.c_void_p, c.c_char_p]),
             "ptrt_pserver_num_updates": (c.c_int64, [c.c_void_p]),
+            "ptrt_pserver_num_lagged": (c.c_int64, [c.c_void_p]),
             "ptrt_client_connect": (c.c_void_p, [c.c_char_p, c.c_int]),
             "ptrt_client_close": (None, [c.c_void_p]),
             "ptrt_client_init_param":
@@ -79,9 +81,11 @@ def lib():
                            c.c_double]),
             "ptrt_client_send_grad":
                 (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64,
-                           c.c_void_p]),
+                           c.c_void_p, c.c_int64,
+                           c.POINTER(c.c_int64)]),
             "ptrt_client_get_param":
-                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64]),
+                (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64,
+                           c.POINTER(c.c_int64)]),
             "ptrt_client_send_sparse_grad":
                 (c.c_int, [c.c_void_p, c.c_char_p, c.c_void_p, c.c_void_p,
                            c.c_int64, c.c_int64]),
@@ -133,9 +137,15 @@ class ParameterServer:
     """In-process pserver (reference: ParameterServerController starts
     pservers in-process for tests; production runs one per host)."""
 
-    def __init__(self, port=0, num_trainers=1, sync=True):
+    def __init__(self, port=0, num_trainers=1, sync=True,
+                 async_lagged_threshold=0):
+        """async_lagged_threshold > 0 discards async gradients computed
+        against parameters more than that many versions old (reference:
+        ParameterServer2.h:243 lagged-async commit control; 0 keeps
+        the unbounded legacy behavior)."""
         self._h = lib().ptrt_pserver_start(port, num_trainers,
-                                           1 if sync else 0)
+                                           1 if sync else 0,
+                                           int(async_lagged_threshold))
 
     @property
     def port(self):
@@ -143,6 +153,10 @@ class ParameterServer:
 
     def num_updates(self):
         return lib().ptrt_pserver_num_updates(self._h)
+
+    def num_lagged(self):
+        """Async gradients discarded by the staleness bound."""
+        return lib().ptrt_pserver_num_lagged(self._h)
 
     def save(self, path):
         return lib().ptrt_pserver_save(self._h, path.encode())
@@ -162,6 +176,10 @@ class PServerClient:
         if not self._h:
             raise ConnectionError("cannot connect to pserver %s:%d"
                                   % (host, port))
+        # last server version seen per param: the base version stamped
+        # onto outgoing gradients for the async staleness bound
+        self._versions = {}
+        self.last_grad_applied = True
 
     def init_param(self, name, value, opt_kind=OPT_SGD, lr=0.01,
                    hp1=0.0, hp2=0.0, hp3=0.0):
@@ -174,23 +192,32 @@ class PServerClient:
 
     def send_grad(self, name, grad):
         """Blocking: returns the freshly updated parameter (sync mode
-        waits for all trainers' gradients)."""
+        waits for all trainers' gradients).  In async mode a gradient
+        older than the server's staleness bound is discarded
+        (last_grad_applied False); the returned parameter is fresh
+        either way, so the trainer resynchronizes."""
         g = _f32(grad).reshape(-1)
         out = np.empty_like(g)
+        new_ver = ctypes.c_int64(0)
         rc = lib().ptrt_client_send_grad(
             self._h, name.encode(), g.ctypes.data_as(ctypes.c_void_p),
-            g.size, out.ctypes.data_as(ctypes.c_void_p))
-        if rc != 0:
+            g.size, out.ctypes.data_as(ctypes.c_void_p),
+            self._versions.get(name, 0), ctypes.byref(new_ver))
+        if rc not in (0, 4):
             raise RuntimeError("send_grad(%s) rc=%d" % (name, rc))
+        self._versions[name] = new_ver.value
+        self.last_grad_applied = rc == 0
         return out
 
     def get_param(self, name, size):
         out = np.empty(size, np.float32)
+        ver = ctypes.c_int64(0)
         rc = lib().ptrt_client_get_param(
             self._h, name.encode(), out.ctypes.data_as(ctypes.c_void_p),
-            out.size)
+            out.size, ctypes.byref(ver))
         if rc != 0:
             raise RuntimeError("get_param(%s) rc=%d" % (name, rc))
+        self._versions[name] = ver.value
         return out
 
     def send_sparse_grad(self, name, rows, values):

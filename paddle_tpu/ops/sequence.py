@@ -575,6 +575,19 @@ def seq_renest(ctx, ins, attrs):
     x = ins["X"][0]
     ref = ins["OuterRef"][0]
     outer = ref.row_splits[0]
+    rows = (x.last_splits().shape[0] - 1 if isinstance(x, RaggedTensor)
+            else x.shape[0])
+    try:  # fail fast in eager mode; outer[-1] is a tracer under jit
+        expected = int(outer[-1])
+    except Exception:
+        expected = None
+    if expected is not None and expected != rows:
+        raise ValueError(
+            "seq_renest: step output has %d %s but the outer splits "
+            "cover %d inner sequences — the nested step must produce "
+            "one row (or one sequence) per subsequence"
+            % (rows, "sequences" if isinstance(x, RaggedTensor)
+               else "rows", expected))
     if isinstance(x, RaggedTensor):
         return {"Out": [RaggedTensor(x.values,
                                      [outer, x.last_splits()],

@@ -1,0 +1,122 @@
+"""Golden ProgramDesc tests: the serialized IR of canonical topologies
+is pinned to checked-in JSON (reference: trainer_config_helpers/tests/
+configs/*.protostr compared by ProtobufEqualMain.cpp — same idea, JSON
+instead of protostr).
+
+Regenerate after an intentional IR change with:
+    GOLDEN_REGEN=1 python -m pytest tests/test_golden_programs.py
+then review the diff like any other code change.
+"""
+
+import json
+import os
+
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "fixtures",
+                          "golden")
+
+
+def _build_fit_a_line():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return fluid.default_main_program()
+
+
+def _build_conv_classifier():
+    img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                               act="relu")
+    pool = fluid.layers.pool2d(input=conv, pool_size=2, pool_stride=2)
+    logits = fluid.layers.fc(input=pool, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=logits, label=label))
+    fluid.optimizer.MomentumOptimizer(learning_rate=0.01,
+                                      momentum=0.9).minimize(loss)
+    return fluid.default_main_program()
+
+
+def _build_dynamic_rnn():
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                          lod_level=1)
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        step = drnn.step_input(x)
+        mem = drnn.memory(shape=[8], batch_ref=step, value=0.0)
+        h = fluid.layers.fc(input=[step, mem], size=8, act="tanh")
+        drnn.update_memory(mem, h)
+        drnn.output(h)
+    last = fluid.layers.sequence_last_step(input=drnn())
+    loss = fluid.layers.mean(x=last)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return fluid.default_main_program()
+
+
+def _build_transpiled_pair():
+    from paddle_tpu.distributed.transpiler import DistributeTranspiler
+
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y))
+    optimize_ops, params_grads = fluid.optimizer.SGD(
+        learning_rate=0.01).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(optimize_ops=optimize_ops, params_grads=params_grads,
+                trainer_id=0, trainers=2, pservers="127.0.0.1:6174")
+    # the trainer program IS the transpiled default main program; the
+    # pserver side is the transpiler's per-endpoint param-block table
+    return {"trainer": fluid.default_main_program().desc.to_dict(),
+            "pserver_blocks": {
+                pname: [[str(ep), int(begin), int(size)]
+                        for ep, begin, size in blocks]
+                for pname, blocks in t.param_blocks.items()}}
+
+
+CASES = {
+    "fit_a_line": lambda: _build_fit_a_line().desc.to_dict(),
+    "conv_classifier": lambda: _build_conv_classifier().desc.to_dict(),
+    "dynamic_rnn": lambda: _build_dynamic_rnn().desc.to_dict(),
+    "transpiled_pair": _build_transpiled_pair,
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_golden_program(case):
+    framework.reset_unique_name()
+    got = CASES[case]()
+    path = os.path.join(GOLDEN_DIR, case + ".json")
+    if os.environ.get("GOLDEN_REGEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(got, f, indent=1, sort_keys=True)
+        pytest.skip("regenerated %s" % path)
+    with open(path) as f:
+        want = json.load(f)
+    # normalize via one json round-trip (tuples -> lists)
+    got = json.loads(json.dumps(got, sort_keys=True))
+    assert got == want, (
+        "ProgramDesc for %r changed; if intentional, regenerate with "
+        "GOLDEN_REGEN=1 and review the diff" % case)
+
+
+def test_golden_roundtrip():
+    """The pinned descs still load and re-serialize identically."""
+    from paddle_tpu.core.desc import ProgramDesc
+
+    for case in ("fit_a_line", "conv_classifier", "dynamic_rnn"):
+        with open(os.path.join(GOLDEN_DIR, case + ".json")) as f:
+            want = json.load(f)
+        desc = ProgramDesc.from_dict(want)
+        again = json.loads(json.dumps(desc.to_dict(), sort_keys=True))
+        assert again == want, case

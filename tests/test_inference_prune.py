@@ -1,0 +1,85 @@
+"""Inference export pruning, incl. sub-block models (reference:
+framework/prune.cc recursion + io.py save/load_inference_model)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io as fluid_io
+
+
+def _build_rnn_classifier():
+    """A model whose forward pass crosses a DynamicRNN sub-block and
+    whose training tail (loss/optimizer) must prune away."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                          lod_level=1)
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        step = drnn.step_input(x)
+        mem = drnn.memory(shape=[6], batch_ref=step, value=0.0)
+        h = fluid.layers.fc(input=[step, mem], size=6, act="tanh")
+        drnn.update_memory(mem, h)
+        drnn.output(h)
+    seq = drnn()
+    last = fluid.layers.sequence_last_step(input=seq)
+    logits = fluid.layers.fc(input=last, size=3, act="softmax")
+    label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    loss = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=logits, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return x, logits, loss
+
+
+def _feed(place, x):
+    rs = np.random.RandomState(0)
+    seqs = [rs.rand(3, 4).tolist(), rs.rand(2, 4).tolist()]
+    feeder = fluid.DataFeeder(feed_list=[x], place=place)
+    return feeder.feed([(s,) for s in seqs])
+
+
+def test_prune_keeps_subblock_graph(tmp_path):
+    x, logits, loss = _build_rnn_classifier()
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeds = _feed(place, x)
+    # save BEFORE the reference run: running the full program would
+    # also apply the SGD update and change the weights being saved
+    fluid_io.save_inference_model(str(tmp_path), ["x"], [logits], exe)
+    full_feeds = dict(feeds)
+    full_feeds["y"] = np.zeros((2, 1), np.int64)
+    want, = exe.run(fluid.default_main_program(), feed=full_feeds,
+                    fetch_list=[logits])
+
+    # pruned program must drop the training tail but keep the rnn
+    pruned = fluid_io.prune_program(fluid.default_main_program(),
+                                    [logits])
+    types = [op.type for op in pruned.desc.block(0).ops]
+    assert "recurrent" in types or "while" in types, types
+    assert not any("grad" in t or t == "sgd" for t in types), types
+
+    # a fresh scope + reload runs the sub-block end to end
+    from paddle_tpu.core import scope as scope_mod
+
+    scope_mod._global_scope = scope_mod.Scope()
+    prog, feed_names, fetch_vars = fluid_io.load_inference_model(
+        str(tmp_path), exe)
+    got, = exe.run(prog, feed=feeds, fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prune_rejects_subblock_target():
+    x, logits, loss = _build_rnn_classifier()
+    prog = fluid.default_main_program()
+    # find a var that lives only inside the rnn sub-block
+    sub_names = set(prog.desc.block(1).vars) - set(prog.desc.block(0).vars)
+    inner = sorted(sub_names)[0]
+    with pytest.raises(ValueError, match="block-0"):
+        fluid_io.prune_program(prog, [inner])
+
+
+def test_prune_rejects_feed_target():
+    x, logits, loss = _build_rnn_classifier()
+    with pytest.raises(ValueError, match="produced by no op"):
+        fluid_io.prune_program(fluid.default_main_program(), [x])

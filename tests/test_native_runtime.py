@@ -71,6 +71,41 @@ def test_pserver_async_mode():
         s.stop()
 
 
+def test_pserver_async_staleness_bound():
+    """Async gradients older than the staleness bound are discarded
+    (reference: ParameterServer2.h:243 lagged-async commit control /
+    ParameterServer2.cpp asyncGrdientCommitCheckAndStat)."""
+    s = native.ParameterServer(num_trainers=2, sync=False,
+                               async_lagged_threshold=2)
+    try:
+        fast = native.PServerClient("127.0.0.1", s.port)
+        slow = native.PServerClient("127.0.0.1", s.port)
+        fast.init_param("w", np.zeros(2, np.float32),
+                        opt_kind=native.OPT_SGD, lr=1.0)
+        # slow trainer reads version 0
+        slow.get_param("w", 2)
+        # fast trainer advances the version past the bound
+        for _ in range(3):
+            fast.send_grad("w", np.ones(2, np.float32))
+            assert fast.last_grad_applied
+        # slow trainer's gradient is 3 versions stale -> discarded,
+        # but it still receives the fresh parameter
+        out = slow.send_grad("w", np.full(2, 100.0, np.float32))
+        assert not slow.last_grad_applied
+        np.testing.assert_allclose(out, -3.0)
+        assert s.num_lagged() == 1
+        assert s.num_updates() == 3
+        # resynchronized now: the next gradient applies
+        out = slow.send_grad("w", np.ones(2, np.float32))
+        assert slow.last_grad_applied
+        np.testing.assert_allclose(out, -4.0)
+        assert s.num_updates() == 4
+        fast.close()
+        slow.close()
+    finally:
+        s.stop()
+
+
 def test_pserver_momentum_and_adam_match_numpy():
     s = native.ParameterServer(num_trainers=1, sync=True)
     try:
