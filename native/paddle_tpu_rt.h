@@ -24,7 +24,7 @@ extern "C" {
  * (reference: ParameterServer2 addGradient + synchronize barriers);
  * sync=0: apply each gradient immediately (reference: asyncSGD).
  * async_lagged > 0 discards async gradients computed against parameters
- * more than that many versions old (reference: ParameterServer2.h:243
+ * at least that many versions old (reference: ParameterServer2.h:243
  * lagged-async commit control); 0 = unbounded. */
 void *ptrt_pserver_start(int port, int num_trainers, int sync,
                          int async_lagged);

@@ -184,7 +184,7 @@ class PServer {
           // asyncGrdientCommitCheckAndStat over
           // FLAGS_async_lagged_grad_discard_ratio,
           // ParameterServer2.h:243): a gradient computed against
-          // parameters more than async_lagged_ versions old is
+          // parameters at least async_lagged_ versions old is
           // discarded; the trainer still receives the fresh value so
           // it resynchronizes instead of looping on stale state.
           if (!sync_ && async_lagged_ > 0 &&

@@ -148,13 +148,18 @@ def _synthetic_dicts():
     return word_dict, verb_dict, label_dict
 
 
-def get_dict():
+def _real_dicts_or_none():
+    """(word, verb, label) dicts from the official files, or None."""
     paths = [fetch_or_none(u, "conll05st", m) for u, m in
              ((WORDDICT_URL, WORDDICT_MD5), (VERBDICT_URL, VERBDICT_MD5),
               (TRGDICT_URL, TRGDICT_MD5))]
     if all(p and os.path.exists(p) for p in paths):
         return tuple(load_dict(p) for p in paths)
-    return _synthetic_dicts()
+    return None
+
+
+def get_dict():
+    return _real_dicts_or_none() or _synthetic_dicts()
 
 
 def build_dicts_from_corpus(corpus_reader):
@@ -245,17 +250,16 @@ def test(words_path=None, props_path=None, dicts=None):
     if words_path and props_path:
         corpus = parse_corpus(words_path, props_path)
         if dicts is None:
-            # real corpus must never pair with the synthetic dict
-            # fallback (its keys aren't BIO tags -> KeyError mid-read);
-            # derive from the corpus unless the real dict files exist
-            paths = [fetch_or_none(u, "conll05st", m) for u, m in
-                     ((WORDDICT_URL, WORDDICT_MD5),
-                      (VERBDICT_URL, VERBDICT_MD5),
-                      (TRGDICT_URL, TRGDICT_MD5))]
-            if all(p and os.path.exists(p) for p in paths):
-                dicts = tuple(load_dict(p) for p in paths)
-            else:
+            # never pair a real corpus with the synthetic dict fallback
+            # (its keys aren't BIO tags -> KeyError mid-read).  A
+            # user-supplied corpus may carry nonstandard labels, so the
+            # explicit path always derives dicts from the corpus; the
+            # official downloaded corpus uses the official dicts.
+            if explicit:
                 dicts = build_dicts_from_corpus(corpus)
+            else:
+                dicts = _real_dicts_or_none() or \
+                    build_dicts_from_corpus(corpus)
         word_dict, verb_dict, label_dict = dicts
         return reader_creator(corpus, word_dict, verb_dict, label_dict)
     return _synthetic_reader(256, 44)

@@ -515,8 +515,23 @@ class Executor:
             return list(val)
         vd = block_desc.vars.get(name)
         arr = np.asarray(val)
+        # int64 feeds execute as int32 (JAX x64 disabled): when the
+        # target dtype actually narrows to int32, check the range
+        # BEFORE the astype so overflow is LOUD instead of silently
+        # wrapping ids (embedding/beam ids beyond 2^31 would corrupt
+        # lookups).  Feeds into float vars keep casting as before.
+        target = (np_dtype(vd.dtype) if vd is not None
+                  and vd.dtype is not None else np.dtype(np.int32))
+        if arr.dtype == np.int64 and target == np.int32 and arr.size \
+                and (arr.max() > np.iinfo(np.int32).max
+                     or arr.min() < np.iinfo(np.int32).min):
+            raise OverflowError(
+                "feed %r: int64 values exceed int32 range (JAX x64 is "
+                "disabled); ids must stay below 2^31" % name)
         if vd is not None and vd.dtype is not None:
             arr = arr.astype(np_dtype(vd.dtype), copy=False)
+        elif arr.dtype == np.int64:
+            arr = arr.astype(np.int32)
         return jax.device_put(arr, self.place.device())
 
     @staticmethod

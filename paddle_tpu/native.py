@@ -140,7 +140,7 @@ class ParameterServer:
     def __init__(self, port=0, num_trainers=1, sync=True,
                  async_lagged_threshold=0):
         """async_lagged_threshold > 0 discards async gradients computed
-        against parameters more than that many versions old (reference:
+        against parameters at least that many versions old (reference:
         ParameterServer2.h:243 lagged-async commit control; 0 keeps
         the unbounded legacy behavior)."""
         self._h = lib().ptrt_pserver_start(port, num_trainers,

@@ -190,14 +190,26 @@ class OpTest:
                 for name, _, _ in _norm_slot(want, self.inputs[want]):
                     check_names.append(name)
 
-        # analytic: seed each output grad with w
+        # analytic: seed each output grad with w (ragged outputs get a
+        # ragged probe sharing the reference splits so the cotangent
+        # pytree matches the primal's)
         wvars = []
         for n in flat_out:
+            ref = self._lookup_output_ref(n)
+            if isinstance(ref, RaggedTensor):
+                probe = RaggedTensor(weights[n],
+                                     [np.asarray(r) for r in
+                                      ref.row_splits], ref.nvalid)
+                lod_level = len(ref.row_splits)
+            else:
+                probe = weights[n]
+                lod_level = 0
             wv = block.create_var(name=n + "@PROBE",
                                   shape=list(weights[n].shape),
-                                  dtype=_np_dtype_str(weights[n]))
+                                  dtype=_np_dtype_str(weights[n]),
+                                  lod_level=lod_level)
             wv.stop_gradient = True
-            feeds[n + "@PROBE"] = weights[n]
+            feeds[n + "@PROBE"] = probe
             wvars.append(wv)
         targets = [block.var(n) for n in flat_out]
         ngs = set(no_grad_set or ())

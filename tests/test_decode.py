@@ -90,12 +90,12 @@ def test_fluid_beam_search_ops():
     from paddle_tpu.ops.registry import get_op_info
 
     # 1 source, 2 beam rows, 3 candidates per row
-    ids = RaggedTensor(jnp.asarray([[3, 4, 5], [6, 7, 8]], jnp.int64),
+    ids = RaggedTensor(np.asarray([[3, 4, 5], [6, 7, 8]], np.int64),
                        [np.array([0, 2]), np.array([0, 1, 2])])
     scores = RaggedTensor(
         jnp.asarray([[0.5, 0.3, 0.2], [0.6, 0.3, 0.1]], jnp.float32),
         [np.array([0, 2]), np.array([0, 1, 2])])
-    pre_ids = jnp.asarray([[1], [1]], jnp.int64)
+    pre_ids = np.asarray([[1], [1]], np.int64)
 
     beam = get_op_info("beam_search").kernel
     outs = beam(None, {"pre_ids": [pre_ids], "ids": [ids],

@@ -43,6 +43,24 @@ def test_no_stale_cache_hit_after_program_rebuild():
         gc.collect()
 
 
+def test_int64_feed_overflow_is_loud():
+    """int64 feeds narrow to int32 (x64 off); out-of-range ids must
+    raise instead of silently wrapping (embedding/beam id corruption)."""
+    import pytest
+
+    x = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+    y = fluid.layers.cast(x=x, dtype="float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    ok = exe.run(fluid.default_main_program(),
+                 feed={"ids": np.array([[5]], np.int64)},
+                 fetch_list=[y])
+    assert float(np.asarray(ok[0]).reshape(-1)[0]) == 5.0
+    with pytest.raises(OverflowError, match="int32 range"):
+        exe.run(fluid.default_main_program(),
+                feed={"ids": np.array([[2 ** 40]], np.int64)},
+                fetch_list=[y])
+
+
 def test_clone_gets_its_own_cache_slot():
     prog = framework.Program()
     startup = framework.Program()
