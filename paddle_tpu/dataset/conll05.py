@@ -251,15 +251,17 @@ def test(words_path=None, props_path=None, dicts=None):
         corpus = parse_corpus(words_path, props_path)
         if dicts is None:
             # never pair a real corpus with the synthetic dict fallback
-            # (its keys aren't BIO tags -> KeyError mid-read).  A
-            # user-supplied corpus may carry nonstandard labels, so the
-            # explicit path always derives dicts from the corpus; the
-            # official downloaded corpus uses the official dicts.
-            if explicit:
-                dicts = build_dicts_from_corpus(corpus)
+            # (its keys aren't BIO tags -> KeyError mid-read).  Prefer
+            # the official dicts — ids then agree with models trained
+            # against get_dict() — but only when they actually cover
+            # this corpus's labels; otherwise derive from the corpus.
+            derived = build_dicts_from_corpus(corpus)
+            official = _real_dicts_or_none()
+            if official is not None and \
+                    set(derived[2]) <= set(official[2]):
+                dicts = official
             else:
-                dicts = _real_dicts_or_none() or \
-                    build_dicts_from_corpus(corpus)
+                dicts = derived
         word_dict, verb_dict, label_dict = dicts
         return reader_creator(corpus, word_dict, verb_dict, label_dict)
     return _synthetic_reader(256, 44)

@@ -14,7 +14,8 @@ from .layer_helper import LayerHelper
 from .initializer import Constant
 from . import layers
 
-__all__ = ["Accuracy", "ChunkEvaluator", "Evaluator"]
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "DetectionMAP",
+           "Evaluator"]
 
 
 def _clone_var_(block, var):
@@ -144,3 +145,100 @@ class ChunkEvaluator(Evaluator):
         f1 = 2 * precision * recall / (precision + recall) \
             if num_correct else 0.0
         return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """Streaming edit distance / CTC sequence error (reference:
+    gserver/evaluators/CTCErrorEvaluator.cpp — total edit distance,
+    instance error rate; fluid analog of the later EditDistance
+    metric).  `input` are hypothesis id sequences, `label` references."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super().__init__("edit_distance", **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+
+        self.total_distance = self.create_state(
+            dtype="float32", shape=[1], suffix="total_distance")
+        self.seq_num = self.create_state(
+            dtype="int32", shape=[1], suffix="seq_num")
+        self.instance_error = self.create_state(
+            dtype="int32", shape=[1], suffix="instance_error")
+
+        dist, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        batch_dist = layers.reduce_sum(input=dist, dim=0, keep_dim=False)
+        # distances are >= 0, so sign(d) is the per-sequence wrong flag
+        wrong = layers.cast(
+            layers.reduce_sum(input=layers.sign(dist), dim=0,
+                              keep_dim=False), dtype="int32")
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.total_distance, batch_dist]},
+            outputs={"Out": [self.total_distance]})
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.seq_num, seq_num]},
+            outputs={"Out": [self.seq_num]})
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.instance_error, wrong]},
+            outputs={"Out": [self.instance_error]})
+        self.metrics.extend([dist])
+        self.states.extend([self.total_distance, self.seq_num,
+                            self.instance_error])
+
+    def eval(self, executor, eval_program=None):
+        from ..core.scope import global_scope
+
+        total = float(np.asarray(
+            global_scope().get(self.total_distance.name)).sum())
+        n = int(np.asarray(global_scope().get(self.seq_num.name)).sum())
+        wrong = int(np.asarray(
+            global_scope().get(self.instance_error.name)).sum())
+        avg = total / n if n else 0.0
+        err = wrong / n if n else 0.0
+        return np.array([avg]), np.array([err])
+
+
+class DetectionMAP(Evaluator):
+    """Detection mean average precision (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp).  The detection_map
+    op scores each batch; eval() reports the UNWEIGHTED mean of batch
+    mAPs (the reference accumulates global per-class TP/FP across the
+    pass; the batch mean keeps the evaluator state in-graph and tracks
+    the same ranking signal, but differs numerically on uneven
+    batches)."""
+
+    def __init__(self, detect_res, label, overlap_threshold=0.5,
+                 background_id=0, ap_type="11point",
+                 evaluate_difficult=False, **kwargs):
+        super().__init__("detection_map", **kwargs)
+        self.map_sum = self.create_state(dtype="float32", shape=[1],
+                                         suffix="map_sum")
+        self.batches = self.create_state(dtype="float32", shape=[1],
+                                         suffix="batches")
+        batch_map = self.helper.create_tmp_variable(
+            dtype="float32", stop_gradient=True)
+        self.helper.append_op(
+            type="detection_map",
+            inputs={"DetectRes": [detect_res], "Label": [label]},
+            outputs={"MAP": [batch_map]},
+            attrs={"overlap_threshold": float(overlap_threshold),
+                   "background_label_id": int(background_id),
+                   "ap_type": ap_type,
+                   "evaluate_difficult": bool(evaluate_difficult)})
+        one = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.map_sum, batch_map]},
+            outputs={"Out": [self.map_sum]})
+        self.helper.append_op(
+            type="sum", inputs={"X": [self.batches, one]},
+            outputs={"Out": [self.batches]})
+        self.metrics.append(batch_map)
+        self.states.extend([self.map_sum, self.batches])
+
+    def eval(self, executor, eval_program=None):
+        from ..core.scope import global_scope
+
+        s = float(np.asarray(global_scope().get(self.map_sum.name)).sum())
+        n = float(np.asarray(global_scope().get(self.batches.name)).sum())
+        return np.array([s / n if n else 0.0])

@@ -142,10 +142,13 @@ class LayerHelper:
         return var
 
     def create_tmp_variable(self, dtype, stop_gradient=False,
-                            lod_level=None):
+                            lod_level=None, shape=None):
+        """`shape` is only needed for host (non-jittable) ops, whose
+        outputs keep their declared meta instead of inferred shapes."""
+        kwargs = {} if shape is None else {"shape": list(shape)}
         return self.main_program.current_block().create_var(
             name=self._uniq("tmp"), dtype=dtype,
-            stop_gradient=stop_gradient, lod_level=lod_level)
+            stop_gradient=stop_gradient, lod_level=lod_level, **kwargs)
 
     def create_variable(self, *args, **kwargs):
         return self.main_program.current_block().create_var(

@@ -216,8 +216,10 @@ def edit_distance(input, label, normalized=False, ignored_tokens=None,
                   **kwargs):
     """reference: edit_distance_op.cc."""
     helper = LayerHelper("edit_distance", **kwargs)
-    out = helper.create_tmp_variable(dtype="float32", stop_gradient=True)
-    seq_num = helper.create_tmp_variable(dtype="int32", stop_gradient=True)
+    out = helper.create_tmp_variable(dtype="float32", stop_gradient=True,
+                                     shape=[-1, 1])
+    seq_num = helper.create_tmp_variable(dtype="int32",
+                                         stop_gradient=True, shape=[1])
     helper.append_op(
         type="edit_distance",
         inputs={"Hyps": [input], "Refs": [label]},

@@ -17,7 +17,7 @@ __act_ops__ = [
 
 __other_ops__ = ["mean", "scale", "clip", "clip_by_norm", "sign"]
 
-__all__ = __act_ops__ + ["mean", "scale"]
+__all__ = __act_ops__ + ["mean", "scale", "sign"]
 
 
 def _make_unary(op_type, out_slot="Out"):
@@ -36,7 +36,7 @@ def _make_unary(op_type, out_slot="Out"):
     return layer
 
 
-for _op in __act_ops__:
+for _op in __act_ops__ + ["sign"]:
     globals()[_op] = _make_unary(_op)
 
 

@@ -357,3 +357,129 @@ def multibox_loss(ctx, ins, attrs):
     denom = jnp.maximum(npos.astype(loc.dtype), 1.0)
     loss = (loc_loss + conf_loss) / denom
     return {"Loss": [loss[:, None]]}
+
+
+@register_op("detection_map", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("DetectRes", "Label"))
+def detection_map(ctx, ins, attrs):
+    """Mean average precision over detection results (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp — 11point or integral
+    AP, greedy best-IoU matching of score-ranked detections against
+    per-image ground truth).
+
+    DetectRes: ragged rows [label, score, xmin, ymin, xmax, ymax]
+    (the detection_output op's layout minus the image column — image
+    identity comes from the lod).  Label: ragged rows
+    [label, xmin, ymin, xmax, ymax] (+ optional difficult flag last).
+    MAP: [1] float.
+    """
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    background = int(attrs.get("background_label_id", 0))
+    ap_type = attrs.get("ap_type", "11point")
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", False))
+
+    det_t, gt_t = ins["DetectRes"][0], ins["Label"][0]
+
+    def unpack(t):
+        if isinstance(t, RaggedTensor):
+            return (np.asarray(t.values)[:int(np.asarray(t.nvalid))],
+                    np.asarray(t.last_splits()))
+        v = np.asarray(t)
+        return v, np.asarray([0, v.shape[0]], np.int64)
+
+    det, det_splits = unpack(det_t)
+    gt, gt_splits = unpack(gt_t)
+    n_img = len(det_splits) - 1
+    has_difficult = gt.shape[1] >= 6
+
+    # per-class pools: detections (img, score, box), gt (img, box, hard)
+    by_class_det, by_class_gt = {}, {}
+    for i in range(n_img):
+        for r in det[det_splits[i]:det_splits[i + 1]]:
+            c = int(r[0])
+            if c != background:
+                by_class_det.setdefault(c, []).append((i, float(r[1]),
+                                                       r[2:6]))
+        for r in gt[gt_splits[i]:gt_splits[i + 1]]:
+            c = int(r[0])
+            hard = bool(r[5]) if has_difficult else False
+            if c != background:
+                by_class_gt.setdefault(c, []).append((i, r[1:5], hard))
+
+    def _iou_np(a, b):
+        """numpy twin of _iou for this host op: [N,4]x[M,4] -> [N,M]."""
+        area_a = np.maximum(a[:, 2] - a[:, 0], 0) * \
+            np.maximum(a[:, 3] - a[:, 1], 0)
+        area_b = np.maximum(b[:, 2] - b[:, 0], 0) * \
+            np.maximum(b[:, 3] - b[:, 1], 0)
+        ix = np.maximum(
+            np.minimum(a[:, None, 2], b[None, :, 2])
+            - np.maximum(a[:, None, 0], b[None, :, 0]), 0)
+        iy = np.maximum(
+            np.minimum(a[:, None, 3], b[None, :, 3])
+            - np.maximum(a[:, None, 1], b[None, :, 1]), 0)
+        inter = ix * iy
+        union = area_a[:, None] + area_b[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    aps = []
+    for c, gts in by_class_gt.items():
+        npos = sum(1 for _, _, hard in gts
+                   if evaluate_difficult or not hard)
+        dets = sorted(by_class_det.get(c, []), key=lambda d: -d[1])
+        # one IoU matrix per class (host numpy, no per-pair dispatch)
+        iou_mat = None
+        if dets:
+            iou_mat = _iou_np(np.stack([d[2] for d in dets]),
+                              np.stack([g[1] for g in gts]))
+        gt_imgs = np.asarray([g[0] for g in gts])
+        matched = set()
+        tps, fps = [], []
+        for di, (img, _score, _box) in enumerate(dets):
+            # VOC protocol (reference DetectionMAPEvaluator): take the
+            # best-IoU gt in the image regardless of matched state; a
+            # duplicate detection of a matched gt is a FALSE POSITIVE,
+            # never re-matched to a lesser gt
+            cand = np.where(gt_imgs == img)[0]
+            if cand.size == 0:
+                tps.append(0.0)
+                fps.append(1.0)
+                continue
+            ious = iou_mat[di, cand]
+            k = int(np.argmax(ious))
+            best_j, best_iou = int(cand[k]), float(ious[k])
+            if best_iou >= overlap_threshold:
+                hard = gts[best_j][2]
+                if hard and not evaluate_difficult:
+                    tps.append(0.0)  # difficult gt: neither tp nor fp
+                    fps.append(0.0)
+                elif best_j not in matched:
+                    matched.add(best_j)
+                    tps.append(1.0)
+                    fps.append(0.0)
+                else:  # duplicate detection
+                    tps.append(0.0)
+                    fps.append(1.0)
+            else:
+                tps.append(0.0)
+                fps.append(1.0)
+        if npos == 0:
+            continue
+        tp_cum = np.cumsum(tps)
+        fp_cum = np.cumsum(fps)
+        recall = tp_cum / npos
+        precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+        if ap_type == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                mask = recall >= t
+                ap += (precision[mask].max() if mask.any() else 0.0) / 11
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for p, r in zip(precision, recall):
+                ap += p * (r - prev_r)
+                prev_r = r
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return {"MAP": [np.asarray([m], np.float32)]}

@@ -172,3 +172,12 @@ bidirectional_lstm = _networks.bidirectional_lstm
 simple_gru = _networks.simple_gru
 
 __all__ = [n for n in dir() if not n.startswith("_")]
+
+# evaluators (reference: trainer_config_helpers/evaluators.py) — every
+# name in the v2 evaluator DSL, kept in sync automatically
+from ..v2 import evaluator as _evaluator  # noqa: E402
+
+globals().update({n: getattr(_evaluator, n)
+                  for n in _evaluator.__all__})
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -13,6 +13,7 @@ from .config import init, _place
 from . import activation
 from . import attr
 from . import data_type
+from . import evaluator
 from . import event
 from . import inference
 from . import layer

@@ -1,0 +1,171 @@
+"""Evaluator breadth: detection mAP, CTC/edit-distance error, and the
+v2 evaluator DSL (reference: gserver/evaluators/Evaluator.cpp,
+CTCErrorEvaluator.cpp, DetectionMAPEvaluator.cpp +
+trainer_config_helpers/evaluators.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as v2
+from paddle_tpu.core.ragged import RaggedTensor
+from paddle_tpu.ops.registry import get_op_info
+
+
+def _rag(rows, splits, dtype=np.float32):
+    return RaggedTensor(jnp.asarray(np.asarray(rows, dtype)),
+                        [np.asarray(splits, np.int64)])
+
+
+def test_detection_map_op_scores():
+    kernel = get_op_info("detection_map").kernel
+    # two images; class 1: one perfect detection + one false positive
+    # ranked below it; class 2: detection misses its gt (IoU 0)
+    det = _rag([[1, 0.9, 0.1, 0.1, 0.5, 0.5],
+                [1, 0.3, 0.7, 0.7, 0.9, 0.9],
+                [2, 0.8, 0.0, 0.0, 0.1, 0.1]], [0, 2, 3])
+    gt = _rag([[1, 0.1, 0.1, 0.5, 0.5],
+               [2, 0.5, 0.5, 0.9, 0.9]], [0, 1, 2])
+    m = float(np.asarray(kernel(None, {"DetectRes": [det],
+                                       "Label": [gt]}, {})["MAP"][0])[0])
+    # class 1 AP = 1.0 (top det matches), class 2 AP = 0 -> mAP 0.5
+    np.testing.assert_allclose(m, 0.5, atol=1e-6)
+
+    # integral ap_type also computes
+    m2 = float(np.asarray(kernel(
+        None, {"DetectRes": [det], "Label": [gt]},
+        {"ap_type": "integral"})["MAP"][0])[0])
+    assert 0.0 <= m2 <= 1.0
+
+
+def test_detection_map_difficult_handling():
+    kernel = get_op_info("detection_map").kernel
+    det = _rag([[1, 0.9, 0.1, 0.1, 0.5, 0.5]], [0, 1])
+    gt_hard = _rag([[1, 0.1, 0.1, 0.5, 0.5, 1.0]], [0, 1])  # difficult
+    out = kernel(None, {"DetectRes": [det], "Label": [gt_hard]}, {})
+    assert float(np.asarray(out["MAP"][0])[0]) == 0.0  # no countable gt
+    out = kernel(None, {"DetectRes": [det], "Label": [gt_hard]},
+                 {"evaluate_difficult": True})
+    np.testing.assert_allclose(np.asarray(out["MAP"][0])[0], 1.0)
+
+
+def test_detection_map_duplicate_is_false_positive():
+    """VOC protocol: a second detection of an already-matched gt is a
+    false positive, never re-matched to a lesser-overlap gt."""
+    kernel = get_op_info("detection_map").kernel
+    det = _rag([[1, 0.9, 0.0, 0.0, 1.0, 1.0],
+                [1, 0.8, 0.0, 0.0, 1.0, 1.0]], [0, 2])
+    gt = _rag([[1, 0.0, 0.0, 1.0, 1.0],
+               [1, 0.0, 0.0, 0.8, 0.8]], [0, 2])
+    m = float(np.asarray(kernel(None, {"DetectRes": [det],
+                                       "Label": [gt]}, {})["MAP"][0])[0])
+    # det2 duplicates gt A -> FP; recall caps at 0.5:
+    # 11-point AP = 6/11 (precision 1.0 up to recall .5, 0 beyond)
+    np.testing.assert_allclose(m, 6.0 / 11.0, atol=1e-6)
+
+
+def test_precision_recall_positive_label():
+    x = v2.layer.data(name="x", type=v2.data_type.dense_vector(3))
+    lab = v2.layer.data(name="lab", type=v2.data_type.integer_value(3))
+    prf = v2.evaluator.precision_recall_evaluator(input=x, label=lab,
+                                                  positive_label=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # predictions: argmax -> [1, 1, 0, 2]; labels [1, 0, 1, 2]
+    probs = np.array([[0.1, 0.8, 0.1], [0.2, 0.7, 0.1],
+                      [0.9, 0.05, 0.05], [0.1, 0.1, 0.8]], np.float32)
+    labels = np.array([[1], [0], [1], [2]], np.int64)
+    out, = exe.run(fluid.default_main_program(),
+                   feed={"x": probs, "lab": labels}, fetch_list=[prf])
+    p, r, f1 = np.asarray(out).reshape(-1)
+    np.testing.assert_allclose(p, 0.5, atol=1e-5)   # 1 tp / 2 pred
+    np.testing.assert_allclose(r, 0.5, atol=1e-5)   # 1 tp / 2 actual
+    np.testing.assert_allclose(f1, 0.5, atol=1e-5)
+
+
+def test_fluid_edit_distance_evaluator():
+    hyp = fluid.layers.data(name="hyp", shape=[1], dtype="int64",
+                            lod_level=1)
+    ref = fluid.layers.data(name="ref", shape=[1], dtype="int64",
+                            lod_level=1)
+    ev = fluid.evaluator.EditDistance(input=hyp, label=ref)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(feed_list=[hyp, ref], place=place)
+    # seq0 identical (distance 0), seq1 one substitution (distance 1)
+    feeds = feeder.feed([([[1], [2], [3]], [[1], [2], [3]]),
+                         ([[4], [5]], [[4], [6]])])
+    exe.run(fluid.default_main_program(), feed=feeds,
+            fetch_list=ev.metrics)
+    avg, err = ev.eval(exe)
+    np.testing.assert_allclose(avg, [0.5])   # (0 + 1) / 2 sequences
+    np.testing.assert_allclose(err, [0.5])   # 1 of 2 wrong
+
+
+def test_fluid_detection_map_evaluator():
+    det = fluid.layers.data(name="det", shape=[6], dtype="float32",
+                            lod_level=1)
+    gt = fluid.layers.data(name="gt", shape=[5], dtype="float32",
+                           lod_level=1)
+    ev = fluid.evaluator.DetectionMAP(detect_res=det, label=gt)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(feed_list=[det, gt], place=place)
+    feeds = feeder.feed([
+        ([[1, 0.9, 0.1, 0.1, 0.5, 0.5]], [[1, 0.1, 0.1, 0.5, 0.5]])])
+    exe.run(fluid.default_main_program(), feed=feeds,
+            fetch_list=ev.metrics)
+    np.testing.assert_allclose(ev.eval(exe), [1.0])
+
+
+def test_v2_evaluator_dsl():
+    x = v2.layer.data(name="x", type=v2.data_type.dense_vector(4))
+    lab = v2.layer.data(name="lab", type=v2.data_type.integer_value(4))
+    probs = v2.layer.fc(input=x, size=4,
+                        act=v2.activation.Softmax())
+    err = v2.evaluator.classification_error_evaluator(input=probs,
+                                                      label=lab)
+    pr = v2.evaluator.precision_recall_evaluator(input=probs, label=lab)
+    colsum = v2.evaluator.column_sum_evaluator(input=probs)
+    total = v2.evaluator.sum_evaluator(input=probs)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    rs = np.random.RandomState(0)
+    feeds = {"x": rs.rand(6, 4).astype(np.float32),
+             "lab": rs.randint(0, 4, (6, 1)).astype(np.int64)}
+    e, p, c, t = exe.run(fluid.default_main_program(), feed=feeds,
+                         fetch_list=[err, pr, colsum, total])
+    assert 0.0 <= float(np.asarray(e).reshape(-1)[0]) <= 1.0
+    assert np.asarray(p).shape[-1] == 6  # macro/micro P R F1
+    assert np.asarray(c).shape == (4,)
+    np.testing.assert_allclose(float(np.asarray(t)), 6.0, rtol=1e-4)
+
+
+def test_v2_ctc_and_auc_evaluators():
+    hyp = v2.layer.data(
+        name="hyp", type=v2.data_type.integer_value_sequence(10))
+    ref = v2.layer.data(
+        name="ref", type=v2.data_type.integer_value_sequence(10))
+    cer = v2.evaluator.ctc_error_evaluator(input=hyp, label=ref)
+
+    score = v2.layer.data(name="score", type=v2.data_type.dense_vector(2))
+    blab = v2.layer.data(name="blab", type=v2.data_type.integer_value(2))
+    auc = v2.evaluator.auc_evaluator(input=score, label=blab)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    blk = fluid.default_main_program().global_block()
+    feeder = fluid.DataFeeder(
+        feed_list=[blk.var("hyp"), blk.var("ref")], place=place)
+    feeds = feeder.feed([([[1], [2]], [[1], [3]])])
+    feeds["score"] = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+    feeds["blab"] = np.array([[1], [0]], np.int64)
+    c, a = exe.run(fluid.default_main_program(), feed=feeds,
+                   fetch_list=[cer, auc])
+    np.testing.assert_allclose(np.asarray(c).reshape(-1), [1.0])
+    assert float(np.asarray(a).reshape(-1)[0]) > 0.9
