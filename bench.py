@@ -1,27 +1,39 @@
-"""Benchmark: ResNet-50 training throughput, batch 128, one chip.
+"""Benchmark suite: training throughput on one chip.
 
-Mirrors the reference benchmark config (reference:
-benchmark/paddle/image/resnet.py + run.sh — ResNet-50, batch 128) on the
-BASELINE.json north-star metric.  vs_baseline is measured against the only
-published in-tree ResNet-50 train number: 82.35 img/s at batch 128 on
-2x Xeon 6148 (reference: benchmark/IntelOptimizedPaddle.md:39-44); the
-north star is P40-class GPU throughput on one TPU chip.
+Mirrors the reference benchmark set (reference: benchmark/paddle/image/
+{resnet,alexnet,vgg,googlenet,smallnet_mnist_cifar}.py + run.sh and
+benchmark/paddle/rnn/rnn.py) on the BASELINE.json north-star metric.
+BENCH_MODEL selects the model (default resnet50 — the driver's
+headline); vs_baseline compares against the strongest published
+in-tree number for that model (BASELINE.md tables).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"step_ms", "mfu", "amp_bf16"}.
 """
 
 import json
 import os
-import sys
 import time
 
 import numpy as np
 
-BASELINE_IMGS_PER_SEC = 82.35  # ResNet-50 batch128, IntelOptimizedPaddle.md
-
 # ResNet-50 training cost model: ~4.1 GFLOP forward per 224x224 image,
-# x3 for forward + backward (dgrad + wgrad) = ~12.3 GFLOP/img.
-TRAIN_GFLOP_PER_IMG_224 = 12.3
+# x3 for forward + backward (dgrad + wgrad) = ~12.3 GFLOP/img.  Other
+# entries use the same x3 rule on the models' published forward FLOPs.
+# Baselines: BASELINE.md (IntelOptimizedPaddle.md CPU img/s tables and
+# benchmark/README.md K40m ms/batch converted to img/s at batch 128).
+_MODELS = {
+    "resnet50": dict(baseline=82.35, gflop=12.3, unit="img/s"),
+    "alexnet": dict(baseline=498.94, gflop=2.1, unit="img/s"),
+    "vgg16": dict(baseline=29.83, gflop=46.5, unit="img/s"),
+    "vgg19": dict(baseline=29.83, gflop=59.0, unit="img/s"),
+    "googlenet": dict(baseline=264.83, gflop=4.8, unit="img/s"),
+    "smallnet": dict(baseline=7039.0, gflop=0.04, unit="img/s"),
+    # strongest published LSTM number: batch 256, hidden 256 on
+    # K40m = 170 ms/batch -> 1506 samples/s (BASELINE.md:26);
+    # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256
+    "lstm": dict(baseline=1506.0, gflop=None, unit="samples/s"),
+}
 
 # MFU denominator: TPU v5e peak (matches the chip the driver benches
 # on); override with BENCH_PEAK_TFLOPS for other hardware.  f32 runs
@@ -30,9 +42,61 @@ DEFAULT_PEAK_TFLOPS_BF16 = 197.0
 DEFAULT_PEAK_TFLOPS_F32 = DEFAULT_PEAK_TFLOPS_BF16 / 2
 
 
+def _build_image_model(model, batch, image_size, class_dim):
+    from paddle_tpu import models
+    from __graft_entry__ import _build_model
+
+    fn = {"resnet50": models.resnet50, "alexnet": models.alexnet,
+          "vgg16": models.vgg16, "vgg19": models.vgg19,
+          "googlenet": models.googlenet,
+          "smallnet": models.smallnet_mnist_cifar}[model]
+    return _build_model(fn, batch, image_size, class_dim, with_loss=True)
+
+
+def _image_feeds(batch, image_size, class_dim, channels=3):
+    rs = np.random.RandomState(0)
+    image = rs.rand(batch, channels, image_size,
+                    image_size).astype(np.float32)
+    label = rs.randint(0, class_dim, size=(batch, 1)).astype(np.int64)
+    return {"image": image, "label": label}
+
+
+def _build_lstm(batch, seq_len, dict_dim, hidden):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.text import stacked_lstm_text_classifier
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        probs = stacked_lstm_text_classifier(data, dict_dim,
+                                             hid_dim=hidden)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=probs, label=label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _lstm_feeds(batch, seq_len, dict_dim):
+    from paddle_tpu.core.ragged import RaggedTensor
+
+    rs = np.random.RandomState(0)
+    seqs = [rs.randint(0, dict_dim, size=(seq_len, 1)).astype(np.int64)
+            for _ in range(batch)]
+    words = RaggedTensor.from_sequences(seqs)
+    label = rs.randint(0, 2, size=(batch, 1)).astype(np.int64)
+    return {"words": words, "label": label}
+
+
 def main():
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model not in _MODELS:
+        raise SystemExit("BENCH_MODEL must be one of %s"
+                         % sorted(_MODELS))
+    spec = _MODELS[model]
     batch = int(os.environ.get("BENCH_BATCH", "128"))
-    image_size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS", "10"))
 
@@ -46,7 +110,6 @@ def main():
 
     import paddle_tpu.fluid as fluid
     from paddle_tpu.jit import FunctionalProgram, state_from_scope
-    from __graft_entry__ import _build_resnet50
 
     # bf16 MXU compute with f32 master weights is the TPU-native
     # training dtype (BENCH_AMP=0 for pure f32)
@@ -54,27 +117,47 @@ def main():
     if amp_bf16:
         fluid.amp.enable_bf16()
 
-    main_prog, startup, logits, avg_loss = _build_resnet50(
-        batch, image_size, 1000, with_loss=True)
+    gflop_per_sample = spec["gflop"]  # None = no FLOP model (lstm)
+    if model == "lstm":
+        seq_len = int(os.environ.get("BENCH_SEQ_LEN", "100"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "256"))
+        dict_dim = int(os.environ.get("BENCH_DICT_DIM", "10000"))
+        main_prog, startup, avg_loss = _build_lstm(batch, seq_len,
+                                                   dict_dim, hidden)
+        feed_names = ["words", "label"]
+        feeds_np = _lstm_feeds(batch, seq_len, dict_dim)
+        metric = "lstm_train_samples_per_sec_batch%d_hidden%d" \
+            % (batch, hidden)
+    else:
+        image_size = int(os.environ.get(
+            "BENCH_IMAGE_SIZE", "32" if model == "smallnet" else "224"))
+        class_dim = int(os.environ.get(
+            "BENCH_CLASS_DIM", "10" if model == "smallnet" else "1000"))
+        main_prog, startup, _, avg_loss = _build_image_model(
+            model, batch, image_size, class_dim)
+        feed_names = ["image", "label"]
+        feeds_np = _image_feeds(batch, image_size, class_dim)
+        # scale the FLOPs model when smoke runs at a tiny image size
+        ref_size = 32.0 if model == "smallnet" else 224.0
+        gflop_per_sample = spec["gflop"] * (image_size / ref_size) ** 2
+        metric = "%s_train_imgs_per_sec_batch%d" % (model, batch)
 
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup, scope=scope)
 
-    fp = FunctionalProgram(main_prog, ["image", "label"], [avg_loss.name])
+    fp = FunctionalProgram(main_prog, feed_names, [avg_loss.name])
     state = state_from_scope(fp, scope)
     dev = jax.devices()[0]
     state = {n: jax.device_put(np.asarray(v), dev)
              for n, v in state.items()}
+    # stochastic ops (alexnet/vgg dropout) draw from a state-carried key
+    from paddle_tpu.fluid.executor import RNG_STATE_NAME
+
+    state[RNG_STATE_NAME] = jax.device_put(jax.random.PRNGKey(0), dev)
 
     step = jax.jit(lambda s, f: fp(s, f), donate_argnums=(0,))
-
-    rs = np.random.RandomState(0)
-    image = jax.device_put(
-        rs.rand(batch, 3, image_size, image_size).astype(np.float32), dev)
-    label = jax.device_put(
-        rs.randint(0, 1000, size=(batch, 1)).astype(np.int64), dev)
-    feeds = {"image": image, "label": label}
+    feeds = jax.device_put(feeds_np, dev)
 
     for _ in range(warmup):
         fetches, state = step(state, feeds)
@@ -86,21 +169,20 @@ def main():
     jax.block_until_ready(fetches)
     dt = time.perf_counter() - t0
 
-    imgs_per_sec = batch * iters / dt
+    samples_per_sec = batch * iters / dt
     step_ms = dt / iters * 1e3
     peak_tflops = float(os.environ.get(
         "BENCH_PEAK_TFLOPS",
         DEFAULT_PEAK_TFLOPS_BF16 if amp_bf16 else DEFAULT_PEAK_TFLOPS_F32))
-    # scale the 224x224 FLOPs model when smoke runs at a tiny image size
-    gflop_per_img = TRAIN_GFLOP_PER_IMG_224 * (image_size / 224.0) ** 2
-    mfu = imgs_per_sec * gflop_per_img / (peak_tflops * 1e3)
+    mfu = (None if gflop_per_sample is None else round(
+        samples_per_sec * gflop_per_sample / (peak_tflops * 1e3), 4))
     print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_batch%d" % batch,
-        "value": round(imgs_per_sec, 2),
-        "unit": "img/s",
-        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        "metric": metric,
+        "value": round(samples_per_sec, 2),
+        "unit": spec["unit"],
+        "vs_baseline": round(samples_per_sec / spec["baseline"], 3),
         "step_ms": round(step_ms, 2),
-        "mfu": round(mfu, 4),
+        "mfu": mfu,
         "amp_bf16": amp_bf16,
     }))
 

@@ -70,6 +70,11 @@ class FunctionalProgram:
     def __call__(self, state, feeds, rng=None):
         env = dict(state)
         env.update(feeds)
+        # rng rides the state dict (RNG_STATE_NAME) so stochastic ops
+        # (dropout, sampling) stay pure: the advanced key is returned
+        # in new_state and feeds the next step
+        if rng is None:
+            rng = env.pop(RNG_STATE_NAME, None)
         ctx = ExecContext(None, self.program, self.block_idx, env, rng=rng)
         for od in self.ops:
             apply_op(ctx, od)
@@ -77,6 +82,11 @@ class FunctionalProgram:
         for n in self.state_out_names:
             if n in env:
                 new_state[n] = env[n]
+        # only round-trip the key when the caller put it in state —
+        # explicit rng= callers (ParallelTrainer) keep the state
+        # structure unchanged for their sharding specs
+        if ctx.rng is not None and RNG_STATE_NAME in state:
+            new_state[RNG_STATE_NAME] = ctx.rng
         fetches = [env[n] for n in self.fetch_names]
         return fetches, new_state
 

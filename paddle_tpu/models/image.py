@@ -43,16 +43,21 @@ def lenet5(image, class_dim=10):
 def smallnet_mnist_cifar(image, class_dim=10):
     """The 'SmallNet' CIFAR-quick benchmark config
     (reference: benchmark/paddle/image/smallnet_mnist_cifar.py —
-    3x conv5-pool3 + fc)."""
-    t = nets.simple_img_conv_pool(input=image, filter_size=5,
-                                  num_filters=32, pool_size=3,
-                                  pool_stride=2, act="relu")
-    t = nets.simple_img_conv_pool(input=t, filter_size=5, num_filters=32,
-                                  pool_size=3, pool_stride=2, act="relu",
-                                  pool_type="avg")
-    t = nets.simple_img_conv_pool(input=t, filter_size=5, num_filters=64,
-                                  pool_size=3, pool_stride=2, act="relu",
-                                  pool_type="avg")
+    conv5(pad2)+maxpool3(s2,p1), conv5(pad2)+avgpool3(s2,p1),
+    conv3(pad1)+avgpool3(s2,p1), fc64, fc; padded so 32x32 inputs
+    survive all three stages)."""
+    t = layers.conv2d(input=image, num_filters=32, filter_size=5,
+                      padding=2, act="relu")
+    t = layers.pool2d(input=t, pool_size=3, pool_stride=2,
+                      pool_padding=1, pool_type="max")
+    t = layers.conv2d(input=t, num_filters=32, filter_size=5,
+                      padding=2, act="relu")
+    t = layers.pool2d(input=t, pool_size=3, pool_stride=2,
+                      pool_padding=1, pool_type="avg")
+    t = layers.conv2d(input=t, num_filters=64, filter_size=3,
+                      padding=1, act="relu")
+    t = layers.pool2d(input=t, pool_size=3, pool_stride=2,
+                      pool_padding=1, pool_type="avg")
     hidden = layers.fc(input=t, size=64, act="relu")
     return layers.fc(input=hidden, size=class_dim, act=None)
 

@@ -142,7 +142,8 @@ def test_v2_evaluator_dsl():
     assert 0.0 <= float(np.asarray(e).reshape(-1)[0]) <= 1.0
     assert np.asarray(p).shape[-1] == 6  # macro/micro P R F1
     assert np.asarray(c).shape == (4,)
-    np.testing.assert_allclose(float(np.asarray(t)), 6.0, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(t).reshape(-1)[0], 6.0,
+                               rtol=1e-4)
 
 
 def test_v2_ctc_and_auc_evaluators():
