@@ -487,7 +487,7 @@ class Executor:
         # reuse executables traced under the old policy
         key = (program._cache_token, program.version, 0,
                tuple(sorted(feed_env.keys())), tuple(fetch_names),
-               flags.get_flag("amp_bf16"))
+               flags.get_flag("amp_bf16"), flags.get_flag("amp_bf16_act"))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = _CompiledProgram(self, program, 0,
@@ -536,8 +536,15 @@ class Executor:
 
     @staticmethod
     def _to_numpy(r):
-        if isinstance(r, RaggedTensor):
-            return r
         if r is None:
             return None
-        return np.asarray(r)
+        if isinstance(r, RaggedTensor):
+            if r.values.dtype == jnp.bfloat16:
+                r = r.with_values(r.values.astype(jnp.float32))
+            return r
+        arr = np.asarray(r)
+        if arr.dtype == jnp.bfloat16:
+            # bf16 is an internal compute dtype (FLAGS_amp_bf16_act);
+            # the feed/fetch contract stays f32
+            arr = arr.astype(np.float32)
+        return arr

@@ -73,7 +73,11 @@ def softmax(ctx, ins, attrs):
     # reference: operators/softmax_op.cc — softmax over the last dim of 2D
     xr = ins["X"][0]
     x = xr.values if isinstance(xr, RaggedTensor) else xr
-    out = jax.nn.softmax(x, axis=-1)
+    if x.dtype == jnp.bfloat16:
+        # f32 exponentials; probabilities back in the activation dtype
+        out = jax.nn.softmax(x.astype(jnp.float32), axis=-1).astype(x.dtype)
+    else:
+        out = jax.nn.softmax(x, axis=-1)
     if isinstance(xr, RaggedTensor):
         return {"Out": [xr.with_values(out)]}
     return {"Out": [out]}

@@ -4,7 +4,8 @@ import jax.numpy as jnp
 
 from ..utils import flags
 
-__all__ = ["mxu_operands", "acc_kwargs", "conv_acc_kwargs", "ACC_DTYPE"]
+__all__ = ["mxu_operands", "acc_kwargs", "conv_acc_kwargs", "ACC_DTYPE",
+           "amp_result", "amp_harmonize", "keep_bf16_acts"]
 
 ACC_DTYPE = jnp.float32
 
@@ -30,6 +31,35 @@ def conv_acc_kwargs(*arrays):
     if any(hasattr(a, "dtype") and a.dtype == jnp.bfloat16 for a in arrays):
         return {}
     return acc_kwargs(*arrays)
+
+
+def keep_bf16_acts():
+    return (flags.get_flag("amp_bf16") and flags.get_flag("amp_bf16_act"))
+
+
+def amp_result(out, ref_dtype):
+    """Cast a heavy-op result to its reference dtype — unless the
+    bf16-activation policy is on, in which case an f32-reference result
+    stays (or becomes) bf16 so the downstream elementwise/norm chain
+    reads and writes half the bytes.  Statistics, losses, and master
+    weights never come through here."""
+    if keep_bf16_acts() and ref_dtype == jnp.float32:
+        return out if out.dtype == jnp.bfloat16 else out.astype(jnp.bfloat16)
+    return out.astype(ref_dtype)
+
+
+def amp_harmonize(x, y):
+    """Under the bf16-activation policy, a binary elementwise op over a
+    (bf16 activation, f32 side-input) pair computes in bf16 — without
+    this, jnp promotion re-materializes the full activation in f32
+    (e.g. the conv bias-add against an f32 bias parameter)."""
+    if not keep_bf16_acts():
+        return x, y
+    if x.dtype == jnp.bfloat16 and y.dtype == jnp.float32:
+        return x, y.astype(jnp.bfloat16)
+    if x.dtype == jnp.float32 and y.dtype == jnp.bfloat16:
+        return x.astype(jnp.bfloat16), y
+    return x, y
 
 
 def mxu_operands(*arrays):

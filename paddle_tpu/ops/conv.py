@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
-from .amp_util import mxu_operands, conv_acc_kwargs
+from .amp_util import mxu_operands, conv_acc_kwargs, amp_result
 from ..core.ragged import RaggedTensor
 
 
@@ -35,7 +35,7 @@ def conv2d(ctx, ins, attrs):
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         **conv_acc_kwargs(xm, wm))
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [amp_result(out, x.dtype)]}
 
 
 @register_op("conv3d")
@@ -53,7 +53,7 @@ def conv3d(ctx, ins, attrs):
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         **conv_acc_kwargs(xm, wm))
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [amp_result(out, x.dtype)]}
 
 
 @register_op("conv2d_transpose")
@@ -79,7 +79,7 @@ def conv2d_transpose(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         **conv_acc_kwargs(xm, wm))
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [amp_result(out, x.dtype)]}
 
 
 def _pool2d_impl(x, attrs):
@@ -102,13 +102,29 @@ def _pool2d_impl(x, attrs):
     else:
         summed = lax.reduce_window(x, 0.0, lax.add, window, strides4, pads)
         if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
-            ones = jnp.ones_like(x)
-            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides4,
-                                       pads)
-            out = summed / counts
+            # per-window valid counts depend only on static shapes:
+            # compute them on host so XLA doesn't constant-fold a full
+            # reduce-window over a ones tensor at compile time
+            counts = _np_pool_counts(
+                (x.shape[2], x.shape[3]), ksize, strides, paddings)
+            out = summed / jnp.asarray(counts, summed.dtype)[None, None]
         else:
             out = summed / (ksize[0] * ksize[1])
     return out
+
+
+def _np_pool_counts(hw, ksize, strides, paddings):
+    # the rectangular-window count factorizes per axis:
+    # counts[i, j] = rows[i] * cols[j]
+    def axis_counts(n, k, s, p):
+        ones = np.pad(np.ones(n, np.float32), (p, p))
+        return np.array([ones[i * s:i * s + k].sum()
+                         for i in range((n + 2 * p - k) // s + 1)],
+                        np.float32)
+
+    return np.outer(
+        axis_counts(hw[0], ksize[0], strides[0], paddings[0]),
+        axis_counts(hw[1], ksize[1], strides[1], paddings[1]))
 
 
 @register_op("pool2d")
@@ -332,4 +348,4 @@ def conv2d_dynamic_filter(ctx, ins, attrs):
         return out[0]
 
     out = jax.vmap(one)(x, w)
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [amp_result(out, x.dtype)]}

@@ -15,7 +15,13 @@ from ..core.ragged import RaggedTensor
 
 
 def _vals(v):
-    return v.values if isinstance(v, RaggedTensor) else v
+    x = v.values if isinstance(v, RaggedTensor) else v
+    # losses always compute/accumulate in f32: bf16 activations
+    # (FLAGS_amp_bf16_act) upcast at the loss boundary -- e.g. log_loss's
+    # 1e-4 epsilon would be absorbed entirely by bf16 rounding near p=1
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    return x
 
 
 def _label_1d(label):

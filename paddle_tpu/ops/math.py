@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op
-from .amp_util import mxu_operands, acc_kwargs
+from .amp_util import mxu_operands, acc_kwargs, amp_result, amp_harmonize
 from ..core.ragged import RaggedTensor
 
 
@@ -44,7 +44,7 @@ def mul(ctx, ins, attrs):
     y2 = _flatten2d(y, yn)
     dtype = jnp.result_type(x.dtype, y.dtype)
     x2, y2 = mxu_operands(x2, y2)
-    out = jnp.dot(x2, y2, **acc_kwargs(x2, y2)).astype(dtype)
+    out = amp_result(jnp.dot(x2, y2, **acc_kwargs(x2, y2)), dtype)
     out_shape = x.shape[:xn] + y.shape[yn:]
     out = jnp.reshape(out, out_shape)
     xin = ins["X"][0]
@@ -63,7 +63,7 @@ def matmul(ctx, ins, attrs):
     dtype = jnp.result_type(x.dtype, y.dtype)
     xm, ym = mxu_operands(x, y)
     out = jnp.matmul(xm, ym, **acc_kwargs(xm, ym))
-    return {"Out": [out.astype(dtype)]}
+    return {"Out": [amp_result(out, dtype)]}
 
 
 # -- elementwise family ------------------------------------------------------
@@ -86,6 +86,7 @@ def _ew(name, fn):
     def kernel(ctx, ins, attrs, fn=fn):
         xr, yr = ins["X"][0], ins["Y"][0]
         x, y = _vals(xr), _vals(yr)
+        x, y = amp_harmonize(x, y)
         out = fn(x, _bcast_y(x, y, attrs.get("axis", -1)))
         if isinstance(xr, RaggedTensor):
             return {"Out": [xr.with_values(out)]}
@@ -110,10 +111,15 @@ def minus(ctx, ins, attrs):
 
 # -- reductions --------------------------------------------------------------
 
-def _reduce(name, fn):
+def _reduce(name, fn, acc_f32=False):
     @register_op(name)
     def kernel(ctx, ins, attrs, fn=fn):
         x = _vals(_x(ins))
+        if acc_f32 and x.dtype == jnp.bfloat16:
+            # sum-style reductions accumulate in f32 (bf16's 8 mantissa
+            # bits saturate after a few hundred ~1.0 addends); max/min
+            # reductions are exact in any dtype and skip this
+            x = x.astype(jnp.float32)
         if attrs.get("reduce_all", False):
             out = fn(x, axis=None)
             out = jnp.reshape(out, (1,) * x.ndim
@@ -130,8 +136,8 @@ def _reduce(name, fn):
     return kernel
 
 
-_reduce("reduce_sum", jnp.sum)
-_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_sum", jnp.sum, acc_f32=True)
+_reduce("reduce_mean", jnp.mean, acc_f32=True)
 _reduce("reduce_max", jnp.max)
 _reduce("reduce_min", jnp.min)
 
@@ -139,8 +145,13 @@ _reduce("reduce_min", jnp.min)
 @register_op("mean")
 def mean(ctx, ins, attrs):
     # scalar outputs are shape-(1,) tensors, matching the reference's
-    # convention for scalars (mean_op.cc InferShape -> {1})
-    return {"Out": [jnp.reshape(jnp.mean(_vals(_x(ins))), (1,))]}
+    # convention for scalars (mean_op.cc InferShape -> {1}); a bf16
+    # input (FLAGS_amp_bf16_act) accumulates in f32 — this is almost
+    # always the final loss reduction
+    x = _vals(_x(ins))
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    return {"Out": [jnp.reshape(jnp.mean(x), (1,))]}
 
 
 @register_op("squared_l2_norm")

@@ -13,6 +13,34 @@ import jax.numpy as jnp
 from .registry import register_op, register_grad_kernel
 
 
+def _bn_axes(x, layout):
+    if layout == "NCHW":
+        return (tuple(i for i in range(x.ndim) if i != 1),
+                (1, -1) + (1,) * (x.ndim - 2))
+    return tuple(range(x.ndim - 1)), (1,) * (x.ndim - 1) + (-1,)
+
+
+def _bn_stats(x, axes):
+    """Batch mean/var, always accumulated in f32 (XLA fuses the convert
+    into the reduction, so a bf16 input is still read once at 2 B/elem)."""
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    return jnp.mean(xs, axis=axes), jnp.var(xs, axis=axes)
+
+
+def _bn_normalize(x, scale, bias, m, v, eps, bshape):
+    inv_std = jax.lax.rsqrt(v + eps)
+    if x.dtype == jnp.bfloat16:
+        # fold the f32 statistics into one per-channel affine and apply
+        # it in bf16: the big tensor is read/written at 2 B/elem and the
+        # chain fuses with the adjacent conv/relu/residual ops
+        a = scale * inv_std
+        b = bias - m * a
+        return x * a.reshape(bshape).astype(x.dtype) + \
+            b.reshape(bshape).astype(x.dtype)
+    return (x - m.reshape(bshape)) * inv_std.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+
+
 @register_op("batch_norm", nondiff_inputs=("Mean", "Variance"))
 def batch_norm(ctx, ins, attrs):
     """reference: batch_norm_op.cc — training mode uses batch statistics
@@ -28,12 +56,7 @@ def batch_norm(ctx, ins, attrs):
     is_test = attrs.get("is_test", False)
     layout = attrs.get("data_layout", "NCHW")
 
-    if layout == "NCHW":
-        axes = tuple(i for i in range(x.ndim) if i != 1)
-        bshape = (1, -1) + (1,) * (x.ndim - 2)
-    else:
-        axes = tuple(range(x.ndim - 1))
-        bshape = (1,) * (x.ndim - 1) + (-1,)
+    axes, bshape = _bn_axes(x, layout)
 
     if is_test:
         use_mean, use_var = mean, variance
@@ -41,16 +64,13 @@ def batch_norm(ctx, ins, attrs):
         saved_mean = mean
         saved_var = variance
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        use_mean, use_var = _bn_stats(x, axes)
         mean_out = momentum * mean + (1 - momentum) * use_mean
         var_out = momentum * variance + (1 - momentum) * use_var
         saved_mean = use_mean
         saved_var = use_var
 
-    inv_std = jax.lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * inv_std.reshape(bshape) * \
-        scale.reshape(bshape) + bias.reshape(bshape)
+    y = _bn_normalize(x, scale, bias, use_mean, use_var, eps, bshape)
     return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
             "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
 
@@ -70,20 +90,12 @@ def batch_norm_grad(ctx, ins, attrs):
     variance = ins["Variance"][0]
 
     def f(x_, scale_, bias_):
-        if layout == "NCHW":
-            axes = tuple(i for i in range(x_.ndim) if i != 1)
-            bshape = (1, -1) + (1,) * (x_.ndim - 2)
-        else:
-            axes = tuple(range(x_.ndim - 1))
-            bshape = (1,) * (x_.ndim - 1) + (-1,)
+        axes, bshape = _bn_axes(x_, layout)
         if is_test:
             m, v = mean, variance
         else:
-            m = jnp.mean(x_, axis=axes)
-            v = jnp.var(x_, axis=axes)
-        inv_std = jax.lax.rsqrt(v + eps)
-        return (x_ - m.reshape(bshape)) * inv_std.reshape(bshape) * \
-            scale_.reshape(bshape) + bias_.reshape(bshape)
+            m, v = _bn_stats(x_, axes)
+        return _bn_normalize(x_, scale_, bias_, m, v, eps, bshape)
 
     _, vjp = jax.vjp(f, x, scale, bias)
     dx, dscale, dbias = vjp(dy)
@@ -99,13 +111,14 @@ def layer_norm(ctx, ins, attrs):
     for d in x.shape[:begin]:
         lead *= d
     x2 = x.reshape(lead, -1)
-    m = jnp.mean(x2, axis=1, keepdims=True)
-    v = jnp.var(x2, axis=1, keepdims=True)
-    norm = (x2 - m) * jax.lax.rsqrt(v + eps)
+    x2s = x2 if x2.dtype == jnp.float32 else x2.astype(jnp.float32)
+    m = jnp.mean(x2s, axis=1, keepdims=True)
+    v = jnp.var(x2s, axis=1, keepdims=True)
+    norm = ((x2s - m) * jax.lax.rsqrt(v + eps)).astype(x.dtype)
     if "Scale" in ins:
-        norm = norm * ins["Scale"][0].reshape(1, -1)
+        norm = norm * ins["Scale"][0].reshape(1, -1).astype(x.dtype)
     if "Bias" in ins:
-        norm = norm + ins["Bias"][0].reshape(1, -1)
+        norm = norm + ins["Bias"][0].reshape(1, -1).astype(x.dtype)
     return {"Y": [norm.reshape(x.shape)], "Mean": [m.reshape(lead)],
             "Variance": [v.reshape(lead)]}
 
@@ -116,8 +129,9 @@ def norm(ctx, ins, attrs):
     x = ins["X"][0]
     axis = int(attrs.get("axis", -1))
     eps = attrs.get("epsilon", 1e-12)
-    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
-    return {"Out": [x / n]}
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    n = jnp.sqrt(jnp.sum(jnp.square(xs), axis=axis, keepdims=True) + eps)
+    return {"Out": [(xs / n).astype(x.dtype)]}
 
 
 @register_op("one_hot", stop_gradient_op=True, nondiff_inputs=("X",))

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
-from .amp_util import mxu_operands, acc_kwargs
+from .amp_util import mxu_operands, acc_kwargs, amp_result
 from ..core.ragged import RaggedTensor
 
 
@@ -29,7 +29,7 @@ def _amp_dot(a, b):
     operands + f32 accumulation under FLAGS_amp_bf16)."""
     dtype = jnp.result_type(a.dtype, b.dtype)
     am, bm = mxu_operands(a, b)
-    return jnp.dot(am, bm, **acc_kwargs(am, bm)).astype(dtype)
+    return amp_result(jnp.dot(am, bm, **acc_kwargs(am, bm)), dtype)
 
 
 def _seg_pos(rt, level=-1):
@@ -340,11 +340,20 @@ def lstm(ctx, ins, attrs):
             peep = (bflat[4 * D:5 * D], bflat[5 * D:6 * D],
                     bflat[6 * D:7 * D])  # Wic, Wif, Woc
 
-    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((B, D), padded.dtype)
-    c0 = ins["C0"][0] if "C0" in ins else jnp.zeros((B, D), padded.dtype)
+    # the recurrence carries are f32 even under FLAGS_amp_bf16_act: the
+    # cell state accumulates across T steps (bf16 would compound rounding
+    # error), and bias/peephole params are f32 so the gate math promotes
+    # to f32 anyway; _amp_dot still feeds the MXU bf16 operands.  The
+    # ragged outputs drop back to the activation dtype below.
+    state_dtype = jnp.float32 if padded.dtype == jnp.bfloat16 \
+        else padded.dtype
+    h0 = (ins["H0"][0] if "H0" in ins
+          else jnp.zeros((B, D))).astype(state_dtype)
+    c0 = (ins["C0"][0] if "C0" in ins
+          else jnp.zeros((B, D))).astype(state_dtype)
 
     xs = jnp.swapaxes(padded, 0, 1)          # [T, B, 4D]
-    mask_t = (jnp.arange(T)[:, None] < lens[None, :]).astype(padded.dtype)
+    mask_t = (jnp.arange(T)[:, None] < lens[None, :]).astype(state_dtype)
 
     def step(carry, inp):
         h_prev, c_prev = carry
@@ -383,8 +392,8 @@ def lstm(ctx, ins, attrs):
 
     like = RaggedTensor(jnp.zeros((x.values.shape[0], D), x.values.dtype),
                         x.row_splits, x.nvalid)
-    hidden = padded_to_ragged(hs, like)
-    cell = padded_to_ragged(cs, like)
+    hidden = padded_to_ragged(hs.astype(x.values.dtype), like)
+    cell = padded_to_ragged(cs.astype(x.values.dtype), like)
     return {"Hidden": [hidden], "Cell": [cell],
             "BatchGate": [x], "BatchCellPreAct": [cell]}
 
@@ -412,15 +421,21 @@ def gru(ctx, ins, attrs):
     if b is not None:
         padded = padded + jnp.reshape(b, (1, 1, -1))
 
-    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((B, D), padded.dtype)
+    # f32 recurrence state under FLAGS_amp_bf16_act (see lstm above)
+    state_dtype = jnp.float32 if x.values.dtype == jnp.bfloat16 \
+        else x.values.dtype
+    h0 = (ins["H0"][0] if "H0" in ins
+          else jnp.zeros((B, D))).astype(state_dtype)
     xs = jnp.swapaxes(padded, 0, 1)
-    mask_t = (jnp.arange(T)[:, None] < lens[None, :]).astype(padded.dtype)
+    mask_t = (jnp.arange(T)[:, None] < lens[None, :]).astype(state_dtype)
 
     def step(h_prev, inp):
         x_t, m = inp
-        ur = act_g(x_t[:, :2 * D] + _amp_dot(h_prev, w_ur))
+        ur = act_g(x_t[:, :2 * D].astype(state_dtype) +
+                   _amp_dot(h_prev, w_ur))
         u, r = ur[:, :D], ur[:, D:]
-        c = act_c(x_t[:, 2 * D:] + _amp_dot(r * h_prev, w_c))
+        c = act_c(x_t[:, 2 * D:].astype(state_dtype) +
+                  _amp_dot(r * h_prev, w_c))
         h = u * h_prev + (1 - u) * c
         m1 = m[:, None]
         h = m1 * h + (1 - m1) * h_prev
@@ -434,7 +449,7 @@ def gru(ctx, ins, attrs):
         hs = jnp.take_along_axis(hs, rev[..., None], axis=1)
     like = RaggedTensor(jnp.zeros((x.values.shape[0], D), x.values.dtype),
                         x.row_splits, x.nvalid)
-    hidden = padded_to_ragged(hs, like)
+    hidden = padded_to_ragged(hs.astype(x.values.dtype), like)
     return {"Hidden": [hidden], "BatchGate": [x],
             "BatchResetHiddenPrev": [hidden], "BatchHidden": [hidden]}
 

@@ -66,5 +66,10 @@ DEFINE_flag("use_debug_nans", False,
 DEFINE_flag("amp_bf16", False,
             "cast MXU op operands (mul/matmul/conv) to bfloat16 with "
             "f32 accumulation (see fluid.amp)")
+DEFINE_flag("amp_bf16_act", True,
+            "when amp_bf16 is on, keep activations bfloat16 between ops "
+            "instead of casting every MXU output back to f32 — halves "
+            "HBM traffic on the elementwise/norm chains; statistics, "
+            "losses, and master weights stay f32")
 
 parse_flags_from_env()

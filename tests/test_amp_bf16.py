@@ -118,3 +118,108 @@ def test_bf16_toggle_invalidates_cached_executable():
     again, = exe.run(fluid.default_main_program(), feed=feed,
                      fetch_list=[y])
     np.testing.assert_allclose(again, f32_out)
+
+
+def test_bf16_activation_policy():
+    """FLAGS_amp_bf16_act: conv/matmul results stay bf16 between ops
+    (halving HBM traffic on the elementwise chains), while fetches and
+    losses remain f32 at the API boundary."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op_info
+    from paddle_tpu.utils import flags
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(2, 4, 8, 8).astype(np.float32))
+    w = jnp.asarray(rs.rand(8, 4, 3, 3).astype(np.float32))
+    conv = get_op_info("conv2d").kernel
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1]}
+
+    with fluid.amp.bf16_guard():
+        out = conv(None, {"Input": [x], "Filter": [w]}, attrs)["Output"][0]
+        assert out.dtype == jnp.bfloat16
+        # policy off: legacy cast-back-to-f32 behaviour
+        flags.set_flag("amp_bf16_act", False)
+        try:
+            out32 = conv(None, {"Input": [x], "Filter": [w]},
+                         attrs)["Output"][0]
+        finally:
+            flags.set_flag("amp_bf16_act", True)
+        assert out32.dtype == jnp.float32
+
+    # executor fetch boundary upcasts bf16 to f32
+    x_in = fluid.layers.data(name="xa", shape=[16], dtype="float32")
+    y = fluid.layers.fc(input=x_in, size=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with fluid.amp.bf16_guard():
+        out, = exe.run(fluid.default_main_program(),
+                       feed={"xa": rs.rand(4, 16).astype(np.float32)},
+                       fetch_list=[y])
+    assert np.asarray(out).dtype == np.float32
+
+
+def test_bf16_act_resnet_loss_matches_f32():
+    """Mini-ResNet first-step loss under the bf16-activation policy is
+    close to the f32 loss (bf16 keeps f32's exponent; ~3 decimal digits
+    of mantissa over this shallow net)."""
+    import jax
+    from paddle_tpu.jit import FunctionalProgram, state_from_scope
+    from __graft_entry__ import _build_model, _mini_resnet
+
+    def first_loss(amp):
+        ctx = fluid.amp.bf16_guard() if amp else _noop()
+        with ctx:
+            main, startup, _, avg_loss = _build_model(
+                _mini_resnet, 4, 16, 16, with_loss=True)
+            scope = fluid.Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope)
+            fp = FunctionalProgram(main, ["image", "label"],
+                                   [avg_loss.name])
+            state = state_from_scope(fp, scope)
+            rs = np.random.RandomState(0)
+            feeds = {"image": rs.rand(4, 3, 16, 16).astype(np.float32),
+                     "label": rs.randint(0, 16, (4, 1)).astype(np.int64)}
+            fetches, _ = jax.jit(lambda s, f: fp(s, f))(state, feeds)
+            return float(np.asarray(fetches[0]).reshape(-1)[0])
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _noop():
+        yield
+
+    l_amp = first_loss(True)
+    l_f32 = first_loss(False)
+    assert abs(l_amp - l_f32) / max(abs(l_f32), 1e-6) < 0.05, \
+        (l_amp, l_f32)
+
+
+def test_bf16_lstm_training_step():
+    """Recurrent path under the bf16-activation policy: the lstm/gru
+    scan carries stay f32 (cross-timestep accumulators) while the MXU
+    projections run bf16 — the scan must be dtype-stable."""
+    from paddle_tpu.core.ragged import RaggedTensor
+    from paddle_tpu.models.text import stacked_lstm_text_classifier
+
+    with fluid.amp.bf16_guard():
+        data = fluid.layers.data(name="w_amp", shape=[1], dtype="int64",
+                                 lod_level=1)
+        probs = stacked_lstm_text_classifier(data, 100, hid_dim=16)
+        label = fluid.layers.data(name="l_amp", shape=[1], dtype="int64")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=probs, label=label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rs = np.random.RandomState(0)
+        seqs = [rs.randint(0, 100, size=(rs.randint(3, 7), 1))
+                .astype(np.int64) for _ in range(6)]
+        feeds = {"w_amp": RaggedTensor.from_sequences(seqs),
+                 "l_amp": rs.randint(0, 2, size=(6, 1)).astype(np.int64)}
+        losses = [float(np.asarray(
+            exe.run(fluid.default_main_program(), feed=feeds,
+                    fetch_list=[loss])[0]).reshape(-1)[0])
+            for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
