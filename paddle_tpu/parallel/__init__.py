@@ -20,9 +20,15 @@ from .sharding import (param_spec, batch_spec, shard_state, shard_feeds,
                        replicated)
 from .trainer import ParallelTrainer, make_parallel_step
 from .ring import ring_attention, ulysses_attention, sp_shard_map
+from .pipeline import (gpipe_spmd, pipeline_apply, split_microbatches,
+                       stack_stage_params)
+from .moe import switch_moe, moe_shard_map, init_moe_params
 
 __all__ = [
     "make_mesh", "MeshConfig", "param_spec", "batch_spec", "shard_state",
     "shard_feeds", "replicated", "ParallelTrainer", "make_parallel_step",
     "ring_attention", "ulysses_attention", "sp_shard_map",
+    "gpipe_spmd", "pipeline_apply", "split_microbatches",
+    "stack_stage_params", "switch_moe", "moe_shard_map",
+    "init_moe_params",
 ]

@@ -22,7 +22,7 @@ import tempfile
 import numpy as np
 
 
-def aggregate_trace(trace_dir, steps):
+def aggregate_trace(trace_dir):
     paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                       recursive=True)
     cat = collections.Counter()
@@ -95,7 +95,7 @@ def main():
             fetches, state = step(state, feeds)
         jax.block_until_ready(fetches)
 
-    cat, flops, per_op, shapes = aggregate_trace(trace_dir, steps)
+    cat, flops, per_op, shapes = aggregate_trace(trace_dir)
     total = sum(cat.values())
     if not total:
         print("no device events captured (trace dir: %s)" % trace_dir)
