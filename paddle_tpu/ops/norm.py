@@ -22,9 +22,17 @@ def _bn_axes(x, layout):
 
 def _bn_stats(x, axes):
     """Batch mean/var, always accumulated in f32 (XLA fuses the convert
-    into the reduction, so a bf16 input is still read once at 2 B/elem)."""
+    into the reduction, so a bf16 input is still read once at 2 B/elem).
+
+    One-pass form E[x^2] - E[x]^2: both reductions share a single sweep
+    over the activation (XLA fuses same-input reduces), where jnp.var's
+    two-pass (x - mean)^2 would read the big tensor twice.  Cancellation
+    is benign here: conv/fc outputs are roughly centered and the
+    accumulators are f32; the max(., 0) guards the round-off edge."""
     xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
-    return jnp.mean(xs, axis=axes), jnp.var(xs, axis=axes)
+    m = jnp.mean(xs, axis=axes)
+    msq = jnp.mean(jnp.square(xs), axis=axes)
+    return m, jnp.maximum(msq - jnp.square(m), 0.0)
 
 
 def _bn_normalize(x, scale, bias, m, v, eps, bshape):

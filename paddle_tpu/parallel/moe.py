@@ -20,10 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from .sharding import shard_map_norep
 
 __all__ = ["switch_moe", "moe_shard_map", "init_moe_params"]
 
@@ -47,12 +44,15 @@ def init_moe_params(key, d_model, d_hidden, n_experts, dtype=jnp.float32):
     }
 
 
-def switch_moe(params, x, axis_name="ep", capacity_factor=1.25):
+def switch_moe(params, x, axis_name="ep", capacity_factor=1.25,
+               batch_axes=()):
     """Per-device MoE layer; call inside shard_map.
 
     params: gate_w [d, E] replicated; w1/b1/w2/b2 with the expert axis
     "ep"-sharded (local leading dim E/ep).  x: [b, d] local tokens.
-    Returns (y [b, d], aux) — aux is the Switch load-balancing loss
+    batch_axes: extra mesh axes the tokens shard over (e.g. ("dp",)) so
+    the aux statistics average over ALL token shards.  Returns
+    (y [b, d], aux) — aux is the Switch load-balancing loss
     (E * sum(fraction_routed * mean_router_prob); ~1 when balanced).
     """
     ep = lax.psum(1, axis_name)
@@ -73,9 +73,11 @@ def switch_moe(params, x, axis_name="ep", capacity_factor=1.25):
     capacity = max(1, int(capacity_factor * b / n_expert))
     pos = jnp.cumsum(onehot, axis=0) - 1.0             # queue position
     in_cap = (pos < capacity) * onehot                 # dropped past C
+    # dispatch is the single place capacity masking happens: one_hot of
+    # a dropped token's slot is zeroed here and nowhere else
     dispatch = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
                               dtype=jnp.float32) * in_cap[..., None]
-    combine = dispatch * (gate * jnp.sum(in_cap, -1))[:, None, None]
+    combine = dispatch * gate[:, None, None]
 
     # --- dispatch: [b,d] -> [E, C, d] -> experts' owners over ICI ---
     # split_axis == concat_axis keeps the exchange self-transposed, so
@@ -104,9 +106,12 @@ def switch_moe(params, x, axis_name="ep", capacity_factor=1.25):
     # --- Switch aux loss: balance fraction-routed vs router mass ---
     frac = jnp.mean(onehot, axis=0)                    # [E]
     mass = jnp.mean(probs, axis=0)                     # [E]
-    # average over the ep data shards so every device agrees
-    frac = lax.pmean(frac, axis_name)
-    mass = lax.pmean(mass, axis_name)
+    # average over EVERY axis the tokens shard across (ep + dp), so the
+    # aux value is identical on all devices — out_specs declares it
+    # replicated and the router gradient must match the reported loss
+    stat_axes = (axis_name,) + tuple(batch_axes)
+    frac = lax.pmean(frac, stat_axes)
+    mass = lax.pmean(mass, stat_axes)
     aux = n_expert * jnp.sum(frac * mass)
     return y, aux
 
@@ -123,11 +128,8 @@ def moe_shard_map(mesh, axis_name="ep", batch_axis="dp",
         "gate_w": P(), "w1": P(axis_name), "b1": P(axis_name),
         "w2": P(axis_name), "b2": P(axis_name),
     }
-    fn = functools.partial(switch_moe, axis_name=axis_name,
-                           capacity_factor=capacity_factor)
-    kwargs = dict(mesh=mesh, in_specs=(param_specs, x_spec),
-                  out_specs=(x_spec, P()))
-    try:
-        return shard_map(fn, check_vma=False, **kwargs)
-    except TypeError:
-        return shard_map(fn, check_rep=False, **kwargs)
+    fn = functools.partial(
+        switch_moe, axis_name=axis_name, capacity_factor=capacity_factor,
+        batch_axes=tuple(a for a in axes if a != axis_name))
+    return shard_map_norep(fn, mesh=mesh, in_specs=(param_specs, x_spec),
+                           out_specs=(x_spec, P()))

@@ -26,10 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from .sharding import shard_map_norep
 
 __all__ = ["gpipe_spmd", "pipeline_apply", "split_microbatches",
            "stack_stage_params"]
@@ -115,24 +112,10 @@ def pipeline_apply(mesh, stage_fn, stacked_params, x, n_microbatches,
         lambda p: P(axis_name), stacked_params)
     x_spec = P(None, db)  # [M, mb, ...]: microbatch dim dp-sharded
 
-    mapped = shard_map(
+    mapped = shard_map_norep(
         functools.partial(gpipe_spmd, fn, axis_name=axis_name),
-        mesh=mesh, in_specs=(param_specs, x_spec), out_specs=x_spec,
-        check_vma=False) if _supports_vma() else shard_map(
-        functools.partial(gpipe_spmd, fn, axis_name=axis_name),
-        mesh=mesh, in_specs=(param_specs, x_spec), out_specs=x_spec,
-        check_rep=False)
+        mesh=mesh, in_specs=(param_specs, x_spec), out_specs=x_spec)
 
     x_mb = split_microbatches(x, n_microbatches)
     out_mb = mapped(stacked_params, x_mb)
     return out_mb.reshape((-1,) + out_mb.shape[2:])
-
-
-@functools.lru_cache(maxsize=1)
-def _supports_vma():
-    import inspect
-
-    try:
-        return "check_vma" in inspect.signature(shard_map).parameters
-    except (TypeError, ValueError):
-        return False

@@ -19,11 +19,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
+from .sharding import shard_map_norep
 from ..kernels.flash_attention import flash_attention, NEG_INF
 
 __all__ = ["ring_attention", "ulysses_attention", "sp_shard_map"]
@@ -142,8 +139,5 @@ def sp_shard_map(fn, mesh, axis_name="sp", dp_axis="dp", mp_axis="mp"):
     batch = dp_axis if dp_axis in mesh.shape else None
     heads = mp_axis if mp_axis in mesh.shape else None
     spec = P(batch, heads, axis_name, None)
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    try:
-        return shard_map(fn, check_vma=False, **kwargs)
-    except TypeError:
-        return shard_map(fn, check_rep=False, **kwargs)
+    return shard_map_norep(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)

@@ -68,3 +68,17 @@ def shard_feeds(feeds, mesh, dp_axis="dp"):
         specs[name] = NamedSharding(mesh, batch_spec(v.shape, mesh,
                                                      dp_axis=dp_axis))
     return specs
+
+
+def shard_map_norep(fn, **kwargs):
+    """shard_map with replication checking off, across jax versions
+    (`check_vma` replaced `check_rep`).  One shim shared by the ring /
+    pipeline / moe modules so the compat logic can't drift."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:
+        return shard_map(fn, check_rep=False, **kwargs)

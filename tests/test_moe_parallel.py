@@ -101,3 +101,18 @@ def test_moe_gradients_flow():
         g = np.asarray(grads[name])
         assert np.isfinite(g).all(), name
         assert np.abs(g).sum() > 0, name
+
+
+def test_moe_aux_identical_across_meshes():
+    """The load-balancing aux averages over every token-sharding axis:
+    the same global batch must yield the same aux on an ep-only mesh
+    and a dp x ep mesh (router grads must match the reported loss)."""
+    params = init_moe_params(4, D, H, E)
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(64, D).astype(np.float32))
+
+    _, aux_ep = moe_shard_map(_mesh((4,), ("ep",)),
+                              capacity_factor=float(E))(params, x)
+    _, aux_dp = moe_shard_map(_mesh((2, 4), ("dp", "ep")),
+                              capacity_factor=float(E))(params, x)
+    np.testing.assert_allclose(float(aux_ep), float(aux_dp), rtol=1e-6)
