@@ -15,4 +15,9 @@ Two complementary mechanisms, matching the reference's split:
 from .transpiler import (DistributeTranspiler, split_dense_variable,
                          run_pserver)
 
-__all__ = ["DistributeTranspiler", "split_dense_variable", "run_pserver"]
+from .coordinator import (init_multihost, global_mesh, process_count,
+                          process_index)
+
+__all__ = ["DistributeTranspiler", "split_dense_variable", "run_pserver",
+           "init_multihost", "global_mesh", "process_count",
+           "process_index"]

@@ -1,0 +1,78 @@
+"""Multi-host device-mesh initialization (ICI/DCN rendezvous).
+
+Replaces the reference's etcd coordination layer (reference:
+go/pserver/etcd_client.go:31-97 TTL-lease registration + desired-count
+rendezvous, go/master/etcd_client.go leader lock) for the collective
+path: on TPU pods the runtime itself provides rendezvous — every host
+calls `jax.distributed.initialize` against one coordinator address and
+the PJRT client wires ICI/DCN; there is no parameter-server in the
+loop.  The pserver/transpiler stack (native/pserver.cc) remains the
+DCN path for sparse/CTR-style workloads; this module is the dense
+collective path's entry point.
+
+Env protocol (set by tools/cluster_launch.py or any scheduler):
+    PADDLE_COORDINATOR   host:port of process 0
+    PADDLE_NUM_PROCESSES world size
+    PADDLE_PROCESS_ID    this host's rank
+"""
+
+import os
+
+__all__ = ["init_multihost", "global_mesh", "process_count",
+           "process_index"]
+
+_initialized = [False]
+
+
+def init_multihost(coordinator=None, num_processes=None, process_id=None,
+                   local_device_ids=None):
+    """Bring up the multi-host JAX runtime.  No-ops on single-host
+    (nothing set and no args) so user scripts can call it
+    unconditionally."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("PADDLE_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_NUM_PROCESSES", "0")) \
+            or None
+    if process_id is None:
+        pid = os.environ.get("PADDLE_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+
+    if coordinator is None and num_processes in (None, 1):
+        return False  # single host; jax is already usable
+    if _initialized[0]:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized[0] = True
+    return True
+
+
+def process_count():
+    import jax
+
+    return jax.process_count()
+
+
+def process_index():
+    import jax
+
+    return jax.process_index()
+
+
+def global_mesh(dp=None, mp=1, sp=1, pp=1, ep=1, devices=None):
+    """Build a Mesh over ALL hosts' devices (jax.devices() is global
+    after init_multihost).  Delegates to parallel.make_mesh with
+    drop_unit_axes=True: only the axes actually >1 appear (plus "dp"),
+    in (dp, mp, sp, pp, ep) order."""
+    import jax
+    from ..parallel.mesh import make_mesh
+
+    devices = devices if devices is not None else jax.devices()
+    return make_mesh(n_devices=len(devices), dp=dp, mp=mp, sp=sp, pp=pp,
+                     ep=ep, axes=("dp", "mp", "sp", "pp", "ep"),
+                     devices=devices, drop_unit_axes=True)

@@ -21,6 +21,7 @@ from . import initializer
 from . import regularizer
 from . import clip
 from . import io
+from . import checkpoint
 from . import evaluator
 from . import amp
 from . import memory_optimization_transpiler

@@ -451,6 +451,20 @@ class _CompiledProgram:
 # Executor
 # ---------------------------------------------------------------------------
 
+def guard_int64_narrowing(arr, name="feed"):
+    """int64 host arrays execute as int32 (JAX x64 disabled).  Make the
+    narrowing LOUD when it would actually wrap — embedding/beam ids
+    beyond 2^31 would silently corrupt lookups otherwise.  Shared by
+    the executor feed path and reader.device_prefetch (which
+    device_puts on a worker thread, before the executor sees it)."""
+    if getattr(arr, "dtype", None) == np.int64 and arr.size \
+            and (arr.max() > np.iinfo(np.int32).max
+                 or arr.min() < np.iinfo(np.int32).min):
+        raise OverflowError(
+            "feed %r: int64 values exceed int32 range (JAX x64 is "
+            "disabled); ids must stay below 2^31" % name)
+
+
 class Executor:
     """reference: python/paddle/v2/fluid/executor.py:149 + executor.cc:79."""
 
@@ -514,6 +528,16 @@ class Executor:
             # host array-of-tensors feed (e.g. beam_search_decode steps)
             return list(val)
         vd = block_desc.vars.get(name)
+        if isinstance(val, jax.Array):
+            # pre-placed feed (reader.device_prefetch): keep it on
+            # device — no host round-trip; the int64 guard already ran
+            # before the worker-thread device_put
+            target = (np_dtype(vd.dtype) if vd is not None
+                      and vd.dtype is not None else None)
+            if target is not None and val.dtype != target \
+                    and target != np.dtype(np.int64):
+                val = val.astype(target)
+            return jax.device_put(val, self.place.device())
         arr = np.asarray(val)
         # int64 feeds execute as int32 (JAX x64 disabled): when the
         # target dtype actually narrows to int32, check the range
@@ -522,12 +546,8 @@ class Executor:
         # lookups).  Feeds into float vars keep casting as before.
         target = (np_dtype(vd.dtype) if vd is not None
                   and vd.dtype is not None else np.dtype(np.int32))
-        if arr.dtype == np.int64 and target == np.int32 and arr.size \
-                and (arr.max() > np.iinfo(np.int32).max
-                     or arr.min() < np.iinfo(np.int32).min):
-            raise OverflowError(
-                "feed %r: int64 values exceed int32 range (JAX x64 is "
-                "disabled); ids must stay below 2^31" % name)
+        if target == np.int32:
+            guard_int64_narrowing(arr, name)
         if vd is not None and vd.dtype is not None:
             arr = arr.astype(np_dtype(vd.dtype), copy=False)
         elif arr.dtype == np.int64:

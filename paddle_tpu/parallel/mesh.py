@@ -30,15 +30,24 @@ class MeshConfig:
         self.axes = tuple(axes)
 
 
-def make_mesh(n_devices=None, dp=None, mp=1, axes=("dp", "mp"),
-              devices=None):
-    """Build a Mesh of `n_devices` with shape (dp, mp).
+def make_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
+              axes=None, devices=None, drop_unit_axes=False):
+    """Build a Mesh over the five parallelism axes.
 
-    dp defaults to n_devices // mp.  With mp=1 this is pure data
-    parallelism (the MultiGradientMachine/parallel_do capability); mp>1
-    shards weights (tensor parallelism — new capability beyond the
-    reference's per-layer ParallelNeuralNetwork placement).
+    dp defaults to n_devices // (mp*sp*pp*ep).  With mp=1 this is pure
+    data parallelism (the MultiGradientMachine/parallel_do capability);
+    mp>1 shards weights (tensor parallelism), sp shards sequences
+    (ring/Ulysses attention), pp pipelines stages, ep shards experts.
+    By default the mesh keeps the ("dp", "mp") axes even at size 1
+    (back-compat with ParallelTrainer); extended axes appear when
+    requested, and drop_unit_axes=True trims every size-1 axis
+    (at least "dp" always remains).
     """
+    sizes = {"dp": dp, "mp": mp, "sp": sp, "pp": pp, "ep": ep}
+    if axes is None:
+        axes = ("dp", "mp") if (sp == pp == ep == 1) else tuple(
+            a for a in ("dp", "mp", "sp", "pp", "ep")
+            if a == "dp" or sizes[a] > 1)
     if devices is None:
         devices = jax.devices()
         if n_devices is not None and len(devices) < n_devices:
@@ -65,13 +74,28 @@ def make_mesh(n_devices=None, dp=None, mp=1, axes=("dp", "mp"),
     if n_devices is None:
         n_devices = len(devices)
     devices = devices[:n_devices]
+    if any(a not in sizes for a in axes):
+        # custom axis NAMES with (dp, mp) semantics, e.g.
+        # axes=("data", "model"): sizes map positionally
+        if len(axes) != 2:
+            raise ValueError("custom axis names are only supported for "
+                             "two-axis (dp, mp)-shaped meshes; got %r"
+                             % (axes,))
+        sizes = {axes[0]: dp, axes[1]: mp}
+        dp_name = axes[0]
+    else:
+        dp_name = "dp"
+    denom = int(np.prod([sizes[a] for a in axes if a != dp_name]))
     if dp is None:
-        if n_devices % mp != 0:
-            raise ValueError("n_devices %d not divisible by mp %d"
-                             % (n_devices, mp))
-        dp = n_devices // mp
-    if dp * mp != n_devices:
-        raise ValueError("dp*mp (%d*%d) != n_devices %d"
-                         % (dp, mp, n_devices))
-    dev_array = np.array(devices).reshape(dp, mp)
+        if n_devices % denom != 0:
+            raise ValueError("n_devices %d not divisible by %d (product "
+                             "of non-dp axes)" % (n_devices, denom))
+        dp = n_devices // denom
+    if dp * denom != n_devices:
+        raise ValueError("axis product (%d*%d) != n_devices %d"
+                         % (dp, denom, n_devices))
+    sizes[dp_name] = dp
+    if drop_unit_axes:
+        axes = tuple(a for a in axes if sizes[a] > 1) or (dp_name,)
+    dev_array = np.array(devices).reshape([sizes[a] for a in axes])
     return Mesh(dev_array, axis_names=tuple(axes))

@@ -1,0 +1,244 @@
+"""CheckpointSaver interval snapshots w/ CRC + torn-write fallback,
+reader prefetching, and the multi-host coordinator's mesh builder."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.checkpoint import (CheckpointSaver, load_checkpoint,
+                                         latest_checkpoint)
+
+
+def _toy_program():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3)
+    loss = fluid.layers.mean(x=y)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_checkpoint_save_load_roundtrip(tmp_path):
+    loss = _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), np.float32)}
+    exe.run(fluid.default_main_program(), feed=feed, fetch_list=[loss])
+
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root, interval_secs=0, max_to_keep=2)
+    snap = saver.save(step=7)
+    saver.wait()
+    assert latest_checkpoint(root) == snap
+
+    # perturb every param, then restore
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.fluid.io import is_persistable
+
+    names = [v.name for v in
+             fluid.default_main_program().list_vars() if is_persistable(v)]
+    before = {n: np.array(global_scope().get(n)) for n in names
+              if global_scope().get(n) is not None}
+    for n in before:
+        global_scope().set(n, np.zeros_like(before[n]))
+    step = load_checkpoint(root)
+    assert step == 7
+    for n, v in before.items():
+        np.testing.assert_array_equal(np.asarray(global_scope().get(n)), v)
+
+
+def test_checkpoint_gc_and_interval(tmp_path):
+    loss = _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root, interval_secs=3600, max_to_keep=2)
+    assert saver.save(1) is not None
+    saver.wait()
+    assert saver.maybe_save(2) is None  # interval not due
+    saver.interval_secs = 0
+    for s in (3, 4, 5):
+        assert saver.maybe_save(s) is not None
+        saver.wait()
+    from paddle_tpu.fluid.checkpoint import _snapshot_dirs
+
+    kept = _snapshot_dirs(root)
+    assert len(kept) == 2
+    assert kept[-1].endswith("%09d" % 5)
+
+
+def test_checkpoint_torn_write_falls_back(tmp_path):
+    loss = _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root, interval_secs=0, max_to_keep=5)
+    saver.save(1)
+    saver.wait()
+    good = latest_checkpoint(root)
+    saver.save(2)
+    saver.wait()
+    bad = latest_checkpoint(root)
+    # corrupt one tensor of snapshot 2 (simulated torn write)
+    manifest = json.load(open(os.path.join(bad, "_manifest.json")))
+    victim = next(iter(manifest.values()))["file"]
+    with open(os.path.join(bad, victim), "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    assert load_checkpoint(root, strict=False) == 1  # fell back to good
+    # a snapshot with no manifest at all is invisible
+    os.remove(os.path.join(bad, "_manifest.json"))
+    assert latest_checkpoint(root) == good
+
+
+def test_host_prefetch_order_and_errors():
+    from paddle_tpu.reader import host_prefetch
+
+    def reader():
+        for i in range(20):
+            yield i
+
+    got = list(host_prefetch(reader, depth=3)())
+    assert got == list(range(20))
+
+    def boom():
+        yield 1
+        raise RuntimeError("reader failed")
+
+    it = host_prefetch(boom)()
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="reader failed"):
+        list(it)
+
+
+def test_device_prefetch_feeds_executor():
+    from paddle_tpu.reader import device_prefetch
+
+    loss = _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rs = np.random.RandomState(0)
+
+    def batches():
+        for _ in range(5):
+            yield {"x": rs.rand(2, 4).astype(np.float32)}
+
+    vals = []
+    for feed in device_prefetch(batches, place=fluid.CPUPlace())():
+        out, = exe.run(fluid.default_main_program(), feed=feed,
+                       fetch_list=[loss])
+        vals.append(float(np.asarray(out).reshape(-1)[0]))
+    assert len(vals) == 5 and all(np.isfinite(v) for v in vals)
+
+
+def test_global_mesh_axis_selection():
+    from paddle_tpu.distributed import global_mesh, init_multihost
+    import jax
+
+    assert init_multihost() is False  # single host no-op
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    m = global_mesh(mp=2, sp=2)
+    assert dict(m.shape) == {"dp": 2, "mp": 2, "sp": 2}
+    m2 = global_mesh(pp=4)
+    assert dict(m2.shape) == {"dp": 2, "pp": 4}
+    with pytest.raises(ValueError):
+        global_mesh(dp=3, mp=5)
+
+
+def test_checkpoint_ragged_persistable_roundtrip(tmp_path):
+    from paddle_tpu.core.ragged import RaggedTensor
+    from paddle_tpu.core.scope import global_scope
+    import jax.numpy as jnp
+
+    _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rt = RaggedTensor.from_sequences(
+        [np.arange(3, dtype=np.float32).reshape(3, 1),
+         np.arange(2, dtype=np.float32).reshape(2, 1)])
+    global_scope().set("ragged_state", rt)
+
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root, interval_secs=0)
+    saver._var_names = lambda: ["ragged_state"]  # focus on the ragged var
+    saver.save(3)
+    saver.wait()
+    global_scope().set("ragged_state", None)
+    assert load_checkpoint(root) == 3
+    back = global_scope().get("ragged_state")
+    np.testing.assert_array_equal(np.asarray(back.values),
+                                  np.asarray(rt.values))
+    np.testing.assert_array_equal(np.asarray(back.row_splits[0]),
+                                  np.asarray(rt.row_splits[0]))
+
+
+def test_checkpoint_all_corrupt_raises_strict(tmp_path):
+    _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root, interval_secs=0)
+    saver.save(1)
+    saver.wait()
+    snap = latest_checkpoint(root)
+    manifest = json.load(open(os.path.join(snap, "_manifest.json")))
+    victim = next(iter(manifest.values()))["file"]
+    with open(os.path.join(snap, victim), "r+b") as f:
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(IOError):
+        load_checkpoint(root)               # strict default
+    assert load_checkpoint(root, strict=False) is None
+    assert load_checkpoint(str(tmp_path / "empty")) is None  # truly empty
+
+
+def test_prefetch_early_abandon_stops_worker():
+    import threading
+    from paddle_tpu.reader import host_prefetch
+
+    before = threading.active_count()
+    produced = []
+
+    def reader():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    for i, item in enumerate(host_prefetch(reader, depth=2)()):
+        if i == 3:
+            break
+    # worker must wind down instead of blocking on the full queue
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+    assert len(produced) < 100  # it stopped early, not after 10k
+
+
+def test_device_prefetch_guards_int64_overflow():
+    from paddle_tpu.reader import device_prefetch
+
+    def batches():
+        yield {"ids": np.array([2 ** 40], dtype=np.int64)}
+
+    with pytest.raises(OverflowError):
+        list(device_prefetch(batches, place=fluid.CPUPlace())())
+
+
+def test_make_mesh_extended_axes():
+    import jax
+    from paddle_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    m = make_mesh(n_devices=8, pp=4)
+    assert dict(m.shape) == {"dp": 2, "pp": 4}
+    m2 = make_mesh(n_devices=8, mp=2, sp=2)
+    assert dict(m2.shape) == {"dp": 2, "mp": 2, "sp": 2}
+    m3 = make_mesh(n_devices=8, mp=2)   # back-compat: keeps (dp, mp)
+    assert dict(m3.shape) == {"dp": 4, "mp": 2}
+    m4 = make_mesh(n_devices=8, mp=1, drop_unit_axes=True)
+    assert dict(m4.shape) == {"dp": 8}
