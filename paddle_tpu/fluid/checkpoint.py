@@ -16,6 +16,7 @@ complete only once its `_MANIFEST` (name -> crc32) lands, which is
 written last and atomically (tmp + rename).
 """
 
+import io
 import json
 import os
 import shutil
@@ -35,6 +36,15 @@ __all__ = ["CheckpointSaver", "load_checkpoint", "latest_checkpoint"]
 
 _MANIFEST = "_manifest.json"
 _PREFIX = "checkpoint_"
+
+
+def _crc_file(path):
+    """Chunked crc32 — never holds the whole tensor file in memory."""
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
 
 
 def _snapshot_dirs(root):
@@ -138,9 +148,9 @@ class CheckpointSaver:
             for name, value in values.items():
                 _save_one(snap, name, value)  # fluid.io npz layout
                 fname = name.replace("/", "_") + ".npz"
-                with open(os.path.join(snap, fname), "rb") as f:
-                    manifest[name] = {"file": fname,
-                                      "crc32": zlib.crc32(f.read())}
+                manifest[name] = {
+                    "file": fname,
+                    "crc32": _crc_file(os.path.join(snap, fname))}
             fd, tmp = tempfile.mkstemp(dir=snap)
             with os.fdopen(fd, "w") as f:
                 json.dump(manifest, f)
@@ -150,11 +160,16 @@ class CheckpointSaver:
             self._error = e
 
     def _gc(self):
-        complete = [s for s in _snapshot_dirs(self.root) if
-                    _is_complete(s)]
-        for stale in complete[:-self.max_to_keep] if self.max_to_keep \
-                else []:
-            shutil.rmtree(stale, ignore_errors=True)
+        # runs on the writer thread AFTER our own manifest landed and
+        # with at most one snapshot in flight (save() joins first), so
+        # any manifest-less directory here is a dead torn write
+        complete, torn = [], []
+        for s in _snapshot_dirs(self.root):
+            (complete if _is_complete(s) else torn).append(s)
+        stale = torn + (complete[:-self.max_to_keep]
+                        if self.max_to_keep else [])
+        for s in stale:
+            shutil.rmtree(s, ignore_errors=True)
 
 
 def load_checkpoint(root_or_snap, scope=None, strict=True):
@@ -189,7 +204,9 @@ def load_checkpoint(root_or_snap, scope=None, strict=True):
                     blob = f.read()
                 if zlib.crc32(blob) != meta["crc32"]:
                     raise IOError("crc mismatch for %s" % name)
-                loaded[name] = _load_one(snap, name)
+                # decode the buffer already in hand: one disk read total
+                loaded[name] = _load_one(snap, name,
+                                         fileobj=io.BytesIO(blob))
         except (IOError, OSError, ValueError, KeyError) as e:
             last_err = e
             continue  # torn snapshot: fall back to an older one

@@ -44,13 +44,18 @@ def _save_one(dirname, name, value):
         np.savez(path, __ragged__=0, values=np.asarray(value))
 
 
-def _load_one(dirname, name, missing_ok=False):
-    path = os.path.join(dirname, name.replace("/", "_") + ".npz")
-    if not os.path.exists(path):
-        if missing_ok:
-            return None
-        raise IOError("no saved var %r under %s" % (name, dirname))
-    with np.load(path) as data:
+def _load_one(dirname, name, missing_ok=False, fileobj=None):
+    """fileobj: already-open file-like holding the npz bytes (lets a
+    caller that just read the file for a CRC pass decode the same
+    buffer instead of re-reading disk — see fluid/checkpoint.py)."""
+    if fileobj is None:
+        path = os.path.join(dirname, name.replace("/", "_") + ".npz")
+        if not os.path.exists(path):
+            if missing_ok:
+                return None
+            raise IOError("no saved var %r under %s" % (name, dirname))
+        fileobj = path
+    with np.load(fileobj) as data:
         if int(data["__ragged__"]) == 1:
             splits = []
             i = 0

@@ -81,10 +81,20 @@ def make_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
             raise ValueError("custom axis names are only supported for "
                              "two-axis (dp, mp)-shaped meshes; got %r"
                              % (axes,))
+        if sp != 1 or pp != 1 or ep != 1:
+            raise ValueError("sp/pp/ep cannot combine with custom axis "
+                             "names %r" % (axes,))
         sizes = {axes[0]: dp, axes[1]: mp}
         dp_name = axes[0]
     else:
         dp_name = "dp"
+        dropped = [a for a, s in sizes.items()
+                   if a not in axes and s not in (None, 1)]
+        if dropped:
+            raise ValueError(
+                "axis size(s) %s requested but axes=%r omits them — an "
+                "explicit axes tuple must name every non-unit axis"
+                % ({a: sizes[a] for a in dropped}, tuple(axes)))
     denom = int(np.prod([sizes[a] for a in axes if a != dp_name]))
     if dp is None:
         if n_devices % denom != 0:
@@ -96,6 +106,8 @@ def make_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
                          % (dp, denom, n_devices))
     sizes[dp_name] = dp
     if drop_unit_axes:
-        axes = tuple(a for a in axes if sizes[a] > 1) or (dp_name,)
+        # "dp" always survives: batch_spec / trainer / moe default to a
+        # dp axis existing, and a dp=1 axis costs nothing
+        axes = tuple(a for a in axes if sizes[a] > 1 or a == dp_name)
     dev_array = np.array(devices).reshape([sizes[a] for a in axes])
     return Mesh(dev_array, axis_names=tuple(axes))

@@ -87,14 +87,15 @@ def device_prefetch(reader, place=None, depth=2):
     reader yields dicts of numpy arrays (executor feed format) or
     tuples/lists of arrays; ragged/selected-rows feeds pass through
     on the host (their layout conversion happens at feed prep).
-    int64 arrays get the executor's loud-overflow narrowing guard
-    BEFORE device_put (which would silently wrap ids past 2^31).
+    int64 arrays ALSO stay on the host: their narrowing policy depends
+    on the target var's dtype, which only the executor knows — a
+    worker-thread device_put would silently wrap ids past 2^31 before
+    the executor's overflow guard could see them.
     """
     import numpy as np
     import jax
 
     from ..core.ragged import RaggedTensor, SelectedRows
-    from ..fluid.executor import guard_int64_narrowing
 
     if place is not None and hasattr(place, "device"):
         device = place.device()
@@ -106,8 +107,7 @@ def device_prefetch(reader, place=None, depth=2):
             return x
         arr = np.asarray(x) if not isinstance(x, jax.Array) else x
         if getattr(arr, "dtype", None) == np.int64:
-            guard_int64_narrowing(arr)
-            arr = arr.astype(np.int32)
+            return x
         try:
             return jax.device_put(arr, device)
         except (TypeError, ValueError):

@@ -218,14 +218,23 @@ def test_prefetch_early_abandon_stops_worker():
     assert len(produced) < 100  # it stopped early, not after 10k
 
 
-def test_device_prefetch_guards_int64_overflow():
+def test_device_prefetch_leaves_int64_on_host():
+    """int64 narrowing depends on the target var dtype, which only the
+    executor knows — device_prefetch must NOT device_put int64 (JAX
+    would silently wrap ids past 2^31 before the executor's guard)."""
+    import jax
     from paddle_tpu.reader import device_prefetch
 
-    def batches():
-        yield {"ids": np.array([2 ** 40], dtype=np.int64)}
+    big = np.array([2 ** 40], dtype=np.int64)
 
-    with pytest.raises(OverflowError):
-        list(device_prefetch(batches, place=fluid.CPUPlace())())
+    def batches():
+        yield {"ids": big, "x": np.ones((1, 4), np.float32)}
+
+    (feed,) = list(device_prefetch(batches, place=fluid.CPUPlace())())
+    assert feed["ids"].dtype == np.int64        # untouched host array
+    assert not isinstance(feed["ids"], jax.Array)
+    assert isinstance(feed["x"], jax.Array)     # floats pre-placed
+    np.testing.assert_array_equal(feed["ids"], big)
 
 
 def test_make_mesh_extended_axes():
@@ -242,3 +251,36 @@ def test_make_mesh_extended_axes():
     assert dict(m3.shape) == {"dp": 4, "mp": 2}
     m4 = make_mesh(n_devices=8, mp=1, drop_unit_axes=True)
     assert dict(m4.shape) == {"dp": 8}
+
+
+def test_checkpoint_gc_removes_torn_snapshots(tmp_path):
+    _toy_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root, interval_secs=0, max_to_keep=5)
+    saver.save(1)
+    saver.wait()
+    # fake a crashed mid-write snapshot: var files, no manifest
+    torn = os.path.join(root, "checkpoint_%09d" % 2)
+    os.makedirs(torn)
+    open(os.path.join(torn, "junk.npz"), "wb").write(b"x")
+    saver.save(3)
+    saver.wait()
+    from paddle_tpu.fluid.checkpoint import _snapshot_dirs
+
+    assert not os.path.exists(torn)          # dead dir collected
+    assert len(_snapshot_dirs(root)) == 2    # steps 1 and 3 remain
+
+
+def test_make_mesh_rejects_dropped_axis_and_keeps_dp():
+    import jax
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.distributed import global_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    with pytest.raises(ValueError, match="omits"):
+        make_mesh(n_devices=8, sp=2, axes=("dp", "mp"))
+    m = global_mesh(mp=8)
+    assert dict(m.shape) == {"dp": 1, "mp": 8}  # dp survives at size 1
