@@ -72,7 +72,11 @@ class SGD:
             place=_place())
 
     def train(self, reader, num_passes=1, event_handler=None,
-              feeding=None):
+              feeding=None, save_dir=None):
+        """save_dir: when set, parameters are written to
+        `save_dir/pass_NNNNN.tar` after every pass — the paddle_trainer
+        `--save_dir` behavior (reference: trainer/ParamUtil.h
+        saveParameters per pass), on top of the event_handler hook."""
         if event_handler is None:
             event_handler = lambda e: None
         feeder = self._feeder(feeding)
@@ -92,6 +96,16 @@ class SGD:
                     pass_id, batch_id))
                 event_handler(v2_event.EndIteration(
                     pass_id, batch_id, cost))
+            if save_dir is not None:
+                import os
+
+                os.makedirs(save_dir, exist_ok=True)
+                path = os.path.join(save_dir, "pass_%05d.tar" % pass_id)
+                # tmp + rename: a crash mid-write must not leave a
+                # truncated tar at the final name
+                with open(path + ".tmp", "wb") as f:
+                    self._parameters.to_tar(f)
+                os.replace(path + ".tmp", path)
             event_handler(v2_event.EndPass(pass_id))
 
     def test(self, reader, feeding=None):
