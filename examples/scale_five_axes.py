@@ -1,0 +1,126 @@
+"""Scale a model over the five parallelism axes on one mesh.
+
+Demonstrates every distributed axis on a virtual CPU mesh (run on a
+real pod by dropping the env overrides and calling
+distributed.init_multihost() on every host):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/scale_five_axes.py
+
+  dp  data parallel      ParallelTrainer gradient all-reduce
+  mp  tensor parallel    sharded weights via GSPMD
+  sp  sequence parallel  ring attention (ppermute ICI ring)
+  pp  pipeline parallel  GPipe microbatch ring (parallel.pipeline)
+  ep  expert parallel    Switch-MoE all_to_all (parallel.moe)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere in the checkout
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import (make_mesh, pipeline_apply,
+                                 stack_stage_params, init_moe_params,
+                                 moe_shard_map)
+
+
+def pipelined_mlp(n_devices):
+    """pp x dp: 4 tanh-MLP stages, microbatches streamed on the ring."""
+    mesh = make_mesh(n_devices=n_devices, pp=4,
+                     axes=("pp", "dp"), drop_unit_axes=False)
+    rs = np.random.RandomState(0)
+    stages = [{"w": jnp.asarray(rs.randn(32, 32).astype(np.float32) * .3),
+               "b": jnp.zeros((32,), jnp.float32)} for _ in range(4)]
+    stacked = stack_stage_params(stages)
+    dp = mesh.shape["dp"]
+    x = jnp.asarray(rs.randn(8 * dp, 32).astype(np.float32))
+    tgt = jnp.tanh(x @ jnp.asarray(rs.randn(32, 32).astype(np.float32)))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(s):
+        return jnp.mean((pipeline_apply(mesh, stage, s, x, 4) - tgt) ** 2)
+
+    step = jax.jit(lambda s: jax.value_and_grad(loss_fn)(s))
+    for i in range(5):
+        loss, grads = step(stacked)
+        stacked = jax.tree_util.tree_map(lambda p, g: p - 0.3 * g,
+                                         stacked, grads)
+        print("pipeline mesh=%s step %d loss %.4f"
+              % (dict(mesh.shape), i, float(loss)), flush=True)
+
+
+def moe_layer(n_devices):
+    """dp x ep: tokens all_to_all to their expert's owner and back."""
+    mesh = make_mesh(n_devices=n_devices, ep=4, axes=("dp", "ep"),
+                     drop_unit_axes=False)
+    params = init_moe_params(0, 32, 64, 8)
+    fn = moe_shard_map(mesh, capacity_factor=2.0)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(128, 32).astype(np.float32))
+
+    def loss_fn(p):
+        y, aux = fn(p, x)
+        return jnp.mean((y - x) ** 2) + 0.01 * aux
+
+    step = jax.jit(lambda p: jax.value_and_grad(loss_fn)(p))
+    for i in range(5):
+        loss, grads = step(params)
+        params = jax.tree_util.tree_map(lambda a, g: a - 0.1 * g,
+                                        params, grads)
+        print("moe mesh=%s step %d loss %.4f"
+              % (dict(mesh.shape), i, float(loss)), flush=True)
+
+
+def sequence_parallel_transformer(n_devices):
+    """dp x mp x sp: ring-attention transformer training step — the
+    sequence axis shards over sp (K/V rotate the ICI ring), weights
+    over mp, batch over dp."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.models.transformer import (init_transformer,
+                                               transformer_loss,
+                                               transformer_param_specs)
+
+    dp, mp, sp = n_devices // 4, 2, 2
+    mesh = Mesh(np.array(jax.devices()[:n_devices]).reshape(dp, mp, sp),
+                axis_names=("dp", "mp", "sp"))
+    params = init_transformer(0, vocab_size=128, n_layer=2, n_head=4,
+                              d_model=64, max_len=64)
+    meta = params["_meta"]
+    specs = transformer_param_specs(params)
+    arrs = {n: jax.device_put(v, NamedSharding(mesh, specs[n]))
+            for n, v in params.items() if n != "_meta"}
+    rs = np.random.RandomState(2)
+    tokens = jnp.asarray(rs.randint(0, 128, (2 * dp, 32)), jnp.int32)
+    targets = jnp.asarray(rs.randint(0, 128, (2 * dp, 32)), jnp.int32)
+
+    def loss_fn(arrs):
+        return transformer_loss({**arrs, "_meta": meta}, tokens, targets,
+                                attn_impl="ring", mesh=mesh)
+
+    with mesh:
+        step = jax.jit(lambda a: jax.value_and_grad(loss_fn)(a))
+        for i in range(3):
+            loss, grads = step(arrs)
+            arrs = {n: v - 0.05 * grads[n] for n, v in arrs.items()}
+            print("transformer mesh=%s step %d loss %.4f"
+                  % (dict(mesh.shape), i, float(loss)), flush=True)
+
+
+def main():
+    n = len(jax.devices())
+    print(n, "devices:", jax.devices()[0].platform, flush=True)
+    pipelined_mlp(n)
+    moe_layer(n)
+    if n % 4 == 0:
+        sequence_parallel_transformer(n)
+
+
+if __name__ == "__main__":
+    main()

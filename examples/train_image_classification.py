@@ -1,0 +1,81 @@
+"""Train an image classifier with the fluid API: bf16 AMP, prefetched
+device feeds, and interval checkpoints.
+
+    python examples/train_image_classification.py            # smallnet
+    MODEL=resnet50 BATCH=64 python examples/train_image_classification.py
+
+Uses the CIFAR-10 reader (synthetic fallback offline; set
+PADDLE_TPU_ALLOW_DOWNLOAD=1 for the real dataset).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere in the checkout
+
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import models
+from paddle_tpu.fluid.checkpoint import CheckpointSaver, load_checkpoint
+from paddle_tpu.reader import device_prefetch
+
+
+def main():
+    model = os.environ.get("MODEL", "smallnet")
+    batch = int(os.environ.get("BATCH", "64"))
+    passes = int(os.environ.get("PASSES", "2"))
+    ckpt_dir = os.environ.get("CKPT_DIR", "/tmp/paddle_tpu_cifar_ckpts")
+
+    fluid.amp.enable_bf16()  # MXU dtype policy; f32 masters
+
+    image = fluid.layers.data(name="image", shape=[3, 32, 32],
+                              dtype="float32")
+    model_fn = {"smallnet": models.smallnet_mnist_cifar,
+                "resnet50": models.resnet50}[model]
+    logits = model_fn(image, class_dim=10)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    loss = fluid.layers.mean(x=fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    acc = fluid.layers.accuracy(input=fluid.layers.softmax(logits),
+                                label=label)
+    fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.01, momentum=0.9).minimize(loss)
+
+    place = fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    start_step = load_checkpoint(ckpt_dir, strict=False) or 0
+    if start_step:
+        print("resumed from step", start_step)
+
+    feeder = fluid.DataFeeder(place=place, feed_list=[image, label])
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.cifar.train10(),
+                              buf_size=2048), batch_size=batch)
+    saver = CheckpointSaver(ckpt_dir, interval_secs=120, max_to_keep=3)
+
+    step = start_step
+    for pass_id in range(passes):
+        feeds = device_prefetch(
+            lambda: (feeder.feed(d) for d in train_reader()), place=place)
+        for feed in feeds():
+            fetched = exe.run(feed=feed, fetch_list=[loss, acc])
+            step += 1
+            if step % 20 == 0:
+                print("pass %d step %d loss %.4f acc %.3f"
+                      % (pass_id, step,
+                         float(np.asarray(fetched[0]).reshape(-1)[0]),
+                         float(np.asarray(fetched[1]).reshape(-1)[0])),
+                      flush=True)
+            saver.maybe_save(step)
+    saver.save(step)
+    saver.wait()
+    print("done; checkpoints in", ckpt_dir)
+
+
+if __name__ == "__main__":
+    main()
