@@ -35,7 +35,22 @@ def conv2d(ctx, ins, attrs):
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv2d", x)
     return {"Output": [amp_result(out, x.dtype)]}
+
+
+def _check_spatial(out, opname, x):
+    """A kernel/stride combination larger than the input silently
+    yields a zero-sized spatial dim and a baffling error far
+    downstream (e.g. a reshape ZeroDivision in the first fc) — fail
+    HERE with the shapes instead.  Only the spatial dims (2:) are
+    checked: an empty batch or channel dim is the caller's business."""
+    if 0 in out.shape[2:]:
+        raise ValueError(
+            "%s produced an empty output %s from input %s — the input "
+            "spatial size is too small for this kernel/stride/padding"
+            % (opname, tuple(out.shape), tuple(x.shape)))
+    return out
 
 
 @register_op("conv3d")
@@ -53,6 +68,7 @@ def conv3d(ctx, ins, attrs):
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv3d", x)
     return {"Output": [amp_result(out, x.dtype)]}
 
 
@@ -79,6 +95,7 @@ def conv2d_transpose(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv2d_transpose", x)
     return {"Output": [amp_result(out, x.dtype)]}
 
 
@@ -110,7 +127,7 @@ def _pool2d_impl(x, attrs):
             out = summed / jnp.asarray(counts, summed.dtype)[None, None]
         else:
             out = summed / (ksize[0] * ksize[1])
-    return out
+    return _check_spatial(out, "pool2d", x)
 
 
 def _np_pool_counts(hw, ksize, strides, paddings):
@@ -152,6 +169,7 @@ def pool3d(ctx, ins, attrs):
     else:
         out = lax.reduce_window(x, 0.0, lax.add, window, strides5, pads) \
             / np.prod(ksize)
+    _check_spatial(out, "pool3d", x)
     return {"Out": [out]}
 
 
@@ -348,4 +366,5 @@ def conv2d_dynamic_filter(ctx, ins, attrs):
         return out[0]
 
     out = jax.vmap(one)(x, w)
+    _check_spatial(out, "conv2d_dynamic_filter", x)
     return {"Output": [amp_result(out, x.dtype)]}
