@@ -35,7 +35,8 @@ _MODELS = {
     "smallnet": dict(baseline=7039.0, gflop=0.04, unit="img/s"),
     # strongest published LSTM number: batch 256, hidden 256 on
     # K40m = 170 ms/batch -> 1506 samples/s (BASELINE.md:26);
-    # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256
+    # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256.
+    # gflop computed per-run from seq_len/hidden, not a constant
     "lstm": dict(baseline=1506.0, gflop=None, unit="samples/s"),
 }
 
@@ -165,7 +166,6 @@ def main():
     if amp_bf16:
         fluid.amp.enable_bf16()
 
-    gflop_per_sample = spec["gflop"]  # None = no FLOP model (lstm)
     if model == "lstm":
         seq_len = int(os.environ.get("BENCH_SEQ_LEN", "100"))
         hidden = int(os.environ.get("BENCH_HIDDEN", "256"))
@@ -176,6 +176,11 @@ def main():
         feeds_np = _lstm_feeds(batch, seq_len, dict_dim)
         metric = "lstm_train_samples_per_sec_batch%d_hidden%d" \
             % (batch, hidden)
+        # stacked-lstm matmul FLOPs per sample: fc1 (emb128->4H) +
+        # 2 recurrent H->4H projections + the layer-2 fc over [4H, H],
+        # x2 MACs, x3 fwd+bwd
+        gflop_per_sample = 3 * 8 * seq_len * hidden * \
+            (128 + 7 * hidden) / 1e9
     else:
         image_size = int(os.environ.get(
             "BENCH_IMAGE_SIZE", "32" if model == "smallnet" else "224"))
