@@ -1,0 +1,44 @@
+"""The examples/ scripts stay runnable.  Opt-in (RUN_EXAMPLES=1):
+each spawns training subprocesses and takes minutes on CPU, so the
+default suite only asserts they parse/import."""
+
+import ast
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(
+    os.path.join(_REPO, "examples", f)
+    for f in os.listdir(os.path.join(_REPO, "examples"))
+    if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("path", _EXAMPLES,
+                         ids=[os.path.basename(p) for p in _EXAMPLES])
+def test_example_parses(path):
+    with open(path) as f:
+        tree = ast.parse(f.read(), path)
+    # every example must be directly runnable and document itself
+    assert ast.get_docstring(tree), path
+    assert any(isinstance(n, ast.If) and "__main__" in ast.dump(n.test)
+               for n in tree.body), "%s has no __main__ guard" % path
+
+
+@pytest.mark.skipif(not os.environ.get("RUN_EXAMPLES"),
+                    reason="spawns real training; set RUN_EXAMPLES=1")
+@pytest.mark.parametrize("path,env", [
+    ("train_image_classification.py", {"PASSES": "1", "BATCH": "16"}),
+    ("scale_five_axes.py", {}),
+    ("dist_pserver_fit_a_line.py", {}),
+], ids=lambda v: v if isinstance(v, str) else "")
+def test_example_runs(path, env):
+    full_env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                **env}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", path)],
+        env=full_env, timeout=900, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
