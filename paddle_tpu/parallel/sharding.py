@@ -6,6 +6,8 @@ parallel_do_op.cc:37-47).  Here placement is declarative: every buffer
 gets a NamedSharding over the mesh and XLA GSPMD partitions the program.
 """
 
+import re as _re
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -68,6 +70,35 @@ def shard_feeds(feeds, mesh, dp_axis="dp"):
         specs[name] = NamedSharding(mesh, batch_spec(v.shape, mesh,
                                                      dp_axis=dp_axis))
     return specs
+
+
+# optimizer accumulator vars are named {param}_{acc}_{N} by
+# fluid/optimizer.py _add_accumulator; these are the acc strings of the
+# 11 optimizers
+_ACC_NAME = _re.compile(
+    r"_(velocity|moment[12]?|inf_norm|avg_squared_grad|"
+    r"avg_squared_update|mean_square|squared|linear)_\d+$")
+
+
+def is_optimizer_state(name):
+    return bool(_ACC_NAME.search(name))
+
+
+def zero1_spec(base_spec, shape, mesh, dp_axis="dp"):
+    """ZeRO-1: shard an optimizer-state tensor over the dp axis on its
+    first free, divisible dim (on top of any mp sharding the matching
+    parameter has).  GSPMD then reduce-scatters the gradient into the
+    shard-wise accumulator update and all-gathers the updated params —
+    all-reduce bandwidth, 1/dp optimizer-state memory."""
+    if dp_axis not in mesh.shape or mesh.shape[dp_axis] == 1:
+        return base_spec
+    dp = mesh.shape[dp_axis]
+    dims = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    for i, (d, s) in enumerate(zip(dims, shape)):
+        if d is None and int(s) % dp == 0 and int(s) >= dp:
+            dims[i] = dp_axis
+            return P(*dims)
+    return base_spec
 
 
 def shard_map_norep(fn, **kwargs):

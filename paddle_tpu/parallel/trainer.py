@@ -14,27 +14,38 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..jit import FunctionalProgram, state_from_scope
-from .sharding import param_spec, batch_spec
+from .sharding import (param_spec, batch_spec, is_optimizer_state,
+                       zero1_spec)
 
 __all__ = ["make_parallel_step", "ParallelTrainer"]
 
 
 def make_parallel_step(program, feed_names, fetch_names, mesh,
                        state_template, dp_axis="dp", mp_axis="mp",
-                       donate_state=True, fp=None):
+                       donate_state=True, fp=None, zero_stage=0):
     """Compile a Program block into a sharded step function.
 
     Returns (step, state_shardings) where
       step(state, feeds, rng) -> (fetches, new_state)
     is jitted with: state sharded per param_spec, feeds sharded on dp,
     fetches replicated (losses/metrics are scalars after mean).
+
+    zero_stage=1 additionally shards the optimizer accumulators
+    (velocity/moment/... vars) over dp — ZeRO-1: GSPMD turns the
+    gradient all-reduce into reduce-scatter + all-gather and each chip
+    keeps 1/dp of the optimizer state.
     """
     if fp is None:
         fp = FunctionalProgram(program, feed_names, fetch_names)
 
+    def spec_for(name, shape):
+        spec = param_spec(name, shape, mesh, mp_axis=mp_axis)
+        if zero_stage >= 1 and is_optimizer_state(name):
+            spec = zero1_spec(spec, shape, mesh, dp_axis=dp_axis)
+        return spec
+
     state_shardings = {
-        name: NamedSharding(mesh, param_spec(name, v.shape, mesh,
-                                             mp_axis=mp_axis))
+        name: NamedSharding(mesh, spec_for(name, v.shape))
         for name, v in state_template.items()
     }
 
@@ -69,7 +80,8 @@ class ParallelTrainer:
     """
 
     def __init__(self, main_program, startup_program, feed_names,
-                 fetch_names, mesh, dp_axis="dp", mp_axis="mp", seed=0):
+                 fetch_names, mesh, dp_axis="dp", mp_axis="mp", seed=0,
+                 zero_stage=0):
         self.main_program = main_program
         self.startup_program = startup_program
         self.feed_names = list(feed_names)
@@ -77,6 +89,7 @@ class ParallelTrainer:
         self.mesh = mesh
         self.dp_axis = dp_axis
         self.mp_axis = mp_axis
+        self.zero_stage = zero_stage
         self._base_rng = jax.random.PRNGKey(seed)
         self._step_count = 0
         self._step_fn = None
@@ -98,7 +111,7 @@ class ParallelTrainer:
         self._step_fn, self._shardings = make_parallel_step(
             self.main_program, self.feed_names, self.fetch_names,
             self.mesh, state, dp_axis=self.dp_axis, mp_axis=self.mp_axis,
-            fp=fp)
+            fp=fp, zero_stage=self.zero_stage)
         # place state on the mesh
         self.state = {
             n: jax.device_put(np.asarray(v), self._shardings[n])
