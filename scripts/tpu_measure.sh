@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Full single-chip measurement sequence (run when the TPU is healthy):
+#   1. headline ResNet-50 bench (batch 128, bf16 + bf16 activations)
+#   2. batch-256 variant (MXU utilization lever)
+#   3. f32 reference point
+#   4. per-HLO-category device profile
+# Appends everything to docs/measurements_$(date +%m%d).log
+set -uo pipefail
+cd "$(dirname "$0")/.."
+log="docs/measurements_$(date +%m%d).log"
+run() {
+  echo "== $* ==" | tee -a "$log"
+  "$@" 2>&1 | tail -3 | tee -a "$log"
+}
+run env BENCH_CLAIM_TIMEOUT=120 python bench.py
+run env BENCH_CLAIM_TIMEOUT=120 BENCH_BATCH=256 python bench.py
+run env BENCH_CLAIM_TIMEOUT=120 BENCH_AMP=0 python bench.py
+run env PROFILE_STEPS=10 python scripts/profile_tpu.py
+echo "done -> $log"
