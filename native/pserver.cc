@@ -71,6 +71,11 @@ class PServer {
     return updates_;
   }
 
+  int64_t numSparseRows() {
+    std::lock_guard<std::mutex> g(mu_);
+    return sparse_rows_;
+  }
+
   int save(const char *path) {
     std::lock_guard<std::mutex> g(mu_);
     Writer w;
@@ -268,6 +273,8 @@ class PServer {
           if (begin + width > e.value.size()) continue;
           e.opt.apply(e.value.data(), vals + i * width, begin,
                       begin + width);
+          sparse_rows_++;  // rows actually applied (observability: lets
+                           // tests prove updates shipped sparse)
         }
         e.version++;
         updates_++;
@@ -338,6 +345,7 @@ class PServer {
   int barrier_count_ = 0;
   int64_t barrier_gen_ = 0;
   int64_t updates_ = 0;
+  int64_t sparse_rows_ = 0;  // total sparse rows applied
   Server server_;
 };
 
@@ -366,6 +374,9 @@ int64_t ptrt_pserver_num_updates(void *s) {
 }
 int64_t ptrt_pserver_num_lagged(void *s) {
   return static_cast<PServer *>(s)->numLagged();
+}
+int64_t ptrt_pserver_num_sparse_rows(void *s) {
+  return static_cast<PServer *>(s)->numSparseRows();
 }
 
 void *ptrt_client_connect(const char *host, int port) {

@@ -77,13 +77,21 @@ class DistributeTranspiler:
     # -- program rewrite ----------------------------------------------------
     def transpile(self, optimize_ops=None, params_grads=None,
                   trainer_id=0, program=None, pservers="127.0.0.1:6174",
-                  trainers=1, sync=True, split_method=split_dense_variable):
+                  trainers=1, sync=True, sync_mode=None,
+                  split_method=split_dense_variable):
+        """sync_mode=False selects async SGD: each trainer's gradient
+        applies immediately server-side with no cross-trainer barrier
+        (reference: ParameterServer2.h asyncSGD:468); pair with
+        run_pserver(sync=False, async_lagged_threshold=N) to bound
+        staleness (ParameterServer2.h:243).  `sync_mode` is the
+        reference-style spelling; `sync` is kept as the original
+        keyword — when both are given sync_mode wins."""
         if program is None:
             program = framework.default_main_program()
         self.program = program
         self.trainer_id = trainer_id
         self.trainers = trainers
-        self.sync = sync
+        self.sync = sync if sync_mode is None else bool(sync_mode)
         endpoints = (pservers.split(",") if isinstance(pservers, str)
                      else list(pservers))
         self.endpoints = endpoints
@@ -244,11 +252,16 @@ def _bname(pname, begin):
     return "%s@%d" % (pname, begin)
 
 
-def run_pserver(endpoint="127.0.0.1:6174", trainers=1, sync=True):
+def run_pserver(endpoint="127.0.0.1:6174", trainers=1, sync=True,
+                async_lagged_threshold=0):
     """Start a pserver for `endpoint` and return the server object
     (reference: the pserver startup path of recv_op/ListenAndServ and
-    paddle_pserver2 main).  Blocks only in __main__ usage; tests call
-    .stop()."""
+    paddle_pserver2 main).  sync=False serves the async-SGD path;
+    async_lagged_threshold > 0 discards gradients computed against
+    parameters at least that many versions old (reference:
+    ParameterServer2.h:243 staleness control).  Blocks only in
+    __main__ usage; tests call .stop()."""
     host, port = endpoint.rsplit(":", 1)
-    return native.ParameterServer(port=int(port), num_trainers=trainers,
-                                  sync=sync)
+    return native.ParameterServer(
+        port=int(port), num_trainers=trainers, sync=sync,
+        async_lagged_threshold=async_lagged_threshold)

@@ -206,6 +206,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             for n in names:
                 if n != EMPTY:
                     _ensure_grad_var(block, _src_of(n))
+        _apply_sparse_grad_types(block, op)
         # per-appended-grad-op hook (reference: backward.py callbacks;
         # error_clip ops are injected right after the grad op)
         for cb in callbacks:
@@ -257,6 +258,7 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
             for n in names:
                 if n != EMPTY:
                     _ensure_grad_var(block, _src_of(n))
+        _apply_sparse_grad_types(block, op)
     block.sync_with_desc()
     return [block.var(g) if g is not None else None for g in grads]
 
@@ -283,6 +285,29 @@ def _src_of(grad_name):
     if base.endswith(GRAD_SUFFIX):
         return base[: -len(GRAD_SUFFIX)]
     return base
+
+
+def _apply_sparse_grad_types(block, op_desc):
+    """Type grad VarDescs that a grad op produces as SelectedRows (the
+    descs default to mirroring the dense forward var).  Driven by the
+    forward op's registry hook — reference: the per-op VarTypeInference
+    pass, e.g. lookup_table_op.cc marking W@GRAD as SelectedRows when
+    is_sparse."""
+    if not op_registry.is_grad_op_type(op_desc.type):
+        return
+    info = _op_info_for(op_registry.forward_type_of_grad(op_desc.type))
+    hook = info.sparse_grad_slots
+    if hook is None:
+        return
+    from ..core.types import VarType
+
+    for slot in hook(op_desc.attrs):
+        for n in op_desc.outputs.get(slot + GRAD_SUFFIX, []):
+            if n == EMPTY:
+                continue
+            vd = block.desc.vars.get(n)
+            if vd is not None:
+                vd.type = VarType.SELECTED_ROWS
 
 
 def _ensure_grad_var(block, src_name):

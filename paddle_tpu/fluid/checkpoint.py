@@ -89,7 +89,9 @@ class CheckpointSaver:
         self.interval_secs = interval_secs
         self.max_to_keep = max_to_keep
         self._program = main_program
-        self._last_time = 0.0
+        # the first interval is honored from construction time: a just-
+        # resumed run should not immediately re-snapshot what it loaded
+        self._last_time = time.time()
         self._thread = None
         self._error = None
 

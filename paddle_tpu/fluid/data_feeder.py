@@ -1,8 +1,13 @@
 """DataFeeder: python data -> device tensors / RaggedTensors.
 
-reference: python/paddle/v2/fluid/data_feeder.py:69 (converts reader rows
-into LoDTensors).  Ragged (lod_level>0) slots become RaggedTensor with
-bucketed flat length so the number of compiled shapes stays bounded.
+Capability parity with the reference feeder (reference:
+python/paddle/v2/fluid/data_feeder.py — reader rows to LoDTensors),
+re-designed for this runtime: dense slots batch-stack straight to a
+device array; ragged (lod_level>0) slots materialize as RaggedTensor
+whose row-splits are computed by a level-by-level flatten at batch end
+(not per-sample recursion), and whose flat length is padded to a
+power-of-two-multiple bucket so the number of distinct XLA
+compilations stays bounded.
 """
 
 import numpy as np
@@ -18,54 +23,70 @@ __all__ = ["DataFeeder"]
 DEFAULT_RAGGED_BUCKET = 64
 
 
-class DataToRaggedConverter:
-    def __init__(self, place, lod_level, shape, dtype, bucket):
+def _nested_row_splits(batch, depth):
+    """Flatten `depth` levels of nesting, one level per sweep, yielding
+    the per-level cumulative row offsets and the flat row list.
+
+    Level k's splits partition level k+1's rows; the innermost rows are
+    the values.  A whole-level sweep with cumsum replaces the
+    reference's per-sample recursive descent — same offsets, and the
+    batch is traversed once per level instead of once per leaf.
+    """
+    splits = []
+    rows = list(batch)
+    for _ in range(depth):
+        lengths = [len(group) for group in rows]
+        splits.append(np.cumsum([0] + lengths).astype(np.int32))
+        rows = [item for group in rows for item in group]
+    return splits, rows
+
+
+def _round_up(n, multiple):
+    return max(multiple, -(-n // multiple) * multiple)
+
+
+class _SlotBatch:
+    """Accumulates one feed slot across the batch, then materializes a
+    device array (dense) or RaggedTensor (ragged)."""
+
+    def __init__(self, place, lod_level, sample_shape, dtype, bucket):
         self.place = place
         self.lod_level = lod_level
-        self.shape = [s for s in shape if s >= 0]
+        self.sample_shape = sample_shape
         self.dtype = dtype
-        self.data = []
-        self.lod = [[0] for _ in range(lod_level)]
         self.bucket = bucket
+        self.samples = []
 
-    def feed(self, data):
-        self._feed_impl_(data, self.lod, self.lod_level)
+    def add(self, sample):
+        self.samples.append(sample)
 
-    def _feed_impl_(self, data, lod, lod_level):
-        if lod_level == 0:
-            self.data.append(data)
-        else:
-            lod[0].append(lod[0][-1] + len(data))
-            for each_data in data:
-                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+    def _to_device(self, arr):
+        import jax
+
+        return jax.device_put(arr, self.place.device())
 
     def done(self):
-        import jax
-
         if self.lod_level == 0:
-            arr = np.array(self.data, dtype=self.dtype)
-            if self.shape is not None:
-                arr = arr.reshape([-1] + list(self.shape))
-            return jax.device_put(arr, self.place.device())
-        flat = [np.asarray(d, dtype=self.dtype) for d in self.data]
-        flat = [f.reshape(self.shape) if self.shape and
-                f.shape != tuple(self.shape) else f for f in flat]
-        values = np.stack(flat, 0) if flat else \
-            np.zeros((0,) + tuple(self.shape), self.dtype)
-        total = values.shape[0]
-        if self.bucket:
-            padded = max(self.bucket,
-                         int(np.ceil(max(total, 1) / self.bucket))
-                         * self.bucket)
-            if padded > total:
-                pad = np.zeros((padded - total,) + values.shape[1:],
-                               values.dtype)
-                values = np.concatenate([values, pad], 0)
-        import jax
+            arr = np.array(self.samples, dtype=self.dtype)
+            if self.sample_shape is not None:
+                arr = arr.reshape([-1] + list(self.sample_shape))
+            return self._to_device(arr)
 
-        return RaggedTensor(
-            jax.device_put(values, self.place.device()),
-            [np.asarray(l, np.int32) for l in self.lod], nvalid=total)
+        splits, rows = _nested_row_splits(self.samples, self.lod_level)
+        shape = tuple(self.sample_shape or ())
+        rows = [np.asarray(r, dtype=self.dtype) for r in rows]
+        rows = [r.reshape(shape) if shape and r.shape != shape else r
+                for r in rows]
+        values = (np.stack(rows, 0) if rows
+                  else np.zeros((0,) + shape, self.dtype))
+        total = values.shape[0]
+        if self.bucket and _round_up(total, self.bucket) > total:
+            pad_rows = _round_up(total, self.bucket) - total
+            values = np.concatenate(
+                [values,
+                 np.zeros((pad_rows,) + values.shape[1:], values.dtype)],
+                axis=0)
+        return RaggedTensor(self._to_device(values), splits, nvalid=total)
 
 
 class DataFeeder:
@@ -89,28 +110,28 @@ class DataFeeder:
             self.feed_shapes.append(each_var.shape)
         self.place = place
 
+    def _sample_shape(self, lod_level, shape):
+        if lod_level == 0:
+            # drop the leading dim only when it is the dynamic batch
+            # dim; append_batch_size=False vars keep their full shape
+            # (reference: data_feeder.py drops negative dims)
+            return (list(shape[1:]) if (shape and shape[0] < 0)
+                    else [s for s in shape if s >= 0] or None)
+        return [s for s in shape if s >= 0]
+
     def feed(self, iterable):
-        converters = []
-        for lod_level, shape, dtype in zip(
-                self.feed_lod_level, self.feed_shapes, self.feed_dtypes):
-            if lod_level == 0:
-                # drop the leading dim only when it is the dynamic batch
-                # dim; append_batch_size=False vars keep their full shape
-                # (reference: data_feeder.py drops negative dims)
-                sample_shape = list(shape[1:]) if (shape and shape[0] < 0) \
-                    else [s for s in shape if s >= 0] or None
-            else:
-                sample_shape = [s for s in shape if s >= 0]
-            converters.append(DataToRaggedConverter(
-                place=self.place, lod_level=lod_level,
-                shape=sample_shape, dtype=dtype,
-                bucket=self.ragged_bucket))
-        for each_sample in iterable:
-            assert len(each_sample) == len(converters), (
-                "size of each sample must equal feed_list")
-            for each_converter, each_slot in zip(converters, each_sample):
-                each_converter.feed(each_slot)
-        ret_dict = {}
-        for each_name, each_converter in zip(self.feed_names, converters):
-            ret_dict[each_name] = each_converter.done()
-        return ret_dict
+        slots = [
+            _SlotBatch(place=self.place, lod_level=lod_level,
+                       sample_shape=self._sample_shape(lod_level, shape),
+                       dtype=dtype, bucket=self.ragged_bucket)
+            for lod_level, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes)]
+        for row in iterable:
+            if len(row) != len(slots):
+                raise ValueError(
+                    "reader row has %d slots, feed_list expects %d"
+                    % (len(row), len(slots)))
+            for slot, value in zip(slots, row):
+                slot.add(value)
+        return {name: slot.done()
+                for name, slot in zip(self.feed_names, slots)}

@@ -1,202 +1,182 @@
-"""Stateful metrics as in-graph state + ops.
+"""In-graph streaming metrics.
 
-reference: python/paddle/v2/fluid/evaluator.py (Evaluator base, Accuracy,
-ChunkEvaluator) — accumulator state lives in persistable vars updated by
-ops appended to the main program; eval() builds a small program computing
-the aggregate.
+Capability parity with the reference's stateful evaluators (reference:
+python/paddle/v2/fluid/evaluator.py — Accuracy, ChunkEvaluator;
+gserver/evaluators/Evaluator.cpp for the CTC/mAP variants), re-designed
+for this runtime rather than transcribed: each metric owns persistable
+counter variables that the main program accumulates into **on device**
+(one fused add per batch, riding the compiled step), while `reset()`
+and `eval()` are **host-side scope operations** — the scope here is a
+host dict of device buffers, so zeroing a counter is a store and the
+final precision/recall/ratio arithmetic is a handful of scalar divides
+that have no business inside an XLA program.  The reference instead
+builds dedicated reset/eval sub-programs and clones state vars into
+them; that machinery buys nothing on this runtime and is gone.
 """
 
 import numpy as np
 
-from . import framework
-from .framework import unique_name, Program, Variable
+from .framework import unique_name
 from .layer_helper import LayerHelper
 from .initializer import Constant
+from ..core.scope import global_scope
+from ..core.types import np_dtype
 from . import layers
 
 __all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "DetectionMAP",
            "Evaluator"]
 
 
-def _clone_var_(block, var):
-    return block.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
-                            lod_level=var.lod_level, persistable=True)
-
-
 class Evaluator:
-    """reference: evaluator.py Evaluator."""
+    """Base: counter plumbing shared by all streaming metrics.
 
-    def __init__(self, name, **kwargs):
-        self.states = []
-        self.metrics = []
-        self.helper = LayerHelper(name, **kwargs)
+    Subclasses append their per-batch ops at construction time (so the
+    counters update as part of the normal training/eval step) and
+    implement `_combine(reads)` mapping counter values to the metric.
+    """
+
+    def __init__(self, prefix, **kwargs):
+        self.helper = LayerHelper(prefix, **kwargs)
+        if self.helper.main_program.current_block().idx != 0:
+            raise ValueError(
+                "streaming metrics accumulate into top-level counters; "
+                "construct the evaluator outside any sub-block")
+        self.metrics = []   # per-batch metric Variables (fetchable)
+        self.states = []    # accumulator Variables (persistable)
+
+    # -- counter plumbing ------------------------------------------------
+
+    def _counter(self, tag, dtype="int32", shape=(1,)):
+        """A persistable accumulator ([1]-shaped unless a per-class
+        shape is asked for), zero-initialized by the startup program."""
+        var = self.helper.create_variable(
+            name=unique_name("%s.%s" % (self.helper.name, tag)),
+            persistable=True, dtype=dtype, shape=list(shape))
+        self.helper.set_variable_initializer(var, Constant(0.0))
+        self.states.append(var)
+        return var
+
+    def _accumulate(self, counter, amount):
+        """counter += amount, on device, as part of the main program."""
+        if amount.dtype != counter.dtype:
+            amount = layers.cast(amount, dtype=counter.dtype)
+        self.helper.append_op(type="sum",
+                              inputs={"X": [counter, amount]},
+                              outputs={"Out": [counter]})
+
+    def _reads(self, scope):
+        """Host values of all counters, in registration order."""
+        return [np.asarray(scope.get(v.name)) for v in self.states]
+
+    # -- public API ------------------------------------------------------
 
     def reset(self, executor, reset_program=None):
-        if reset_program is None:
-            reset_program = Program()
-        with framework.program_guard(main_program=reset_program):
-            for var in self.states:
-                assert isinstance(var, Variable)
-                g_var = _clone_var_(reset_program.current_block(), var)
-                layers.fill_constant(shape=g_var.shape, value=0.0,
-                                     dtype=g_var.dtype, out=g_var)
-        executor.run(reset_program)
+        """Zero every counter.  Direct host stores into the scope; the
+        `executor`/`reset_program` arguments are accepted for drop-in
+        compatibility with the reference signature but no program run
+        is needed on this runtime."""
+        scope = global_scope()
+        for var in self.states:
+            scope.set(var.name,
+                      np.zeros([int(d) for d in var.shape] or [1],
+                               np_dtype(var.dtype)))
 
     def eval(self, executor, eval_program=None):
-        raise NotImplementedError()
+        return self._combine(self._reads(global_scope()))
 
+    def _combine(self, reads):
+        raise NotImplementedError(type(self).__name__)
+
+    # compat shim for code written against the reference's method name
     def create_state(self, suffix, dtype, shape):
-        state = self.helper.create_variable(
-            name="_".join([unique_name(self.helper.name), suffix]),
-            persistable=True, dtype=dtype, shape=shape)
-        self.helper.set_variable_initializer(state, Constant(0.0))
-        return state
+        return self._counter(suffix, dtype=dtype, shape=shape)
+
+
+def _ratio(num, den):
+    return float(num) / float(den) if den else 0.0
 
 
 class Accuracy(Evaluator):
-    """Streaming accuracy (reference: evaluator.py Accuracy)."""
+    """Streaming top-k accuracy: correct/total over every batch since
+    the last reset (reference: fluid/evaluator.py Accuracy on top of
+    accuracy_op.h)."""
 
     def __init__(self, input, label, k=1, **kwargs):
         super().__init__("accuracy", **kwargs)
-        main_program = self.helper.main_program
-        if main_program.current_block().idx != 0:
-            raise ValueError("You can only invoke Evaluator in root block")
+        self.correct = self._counter("correct")
+        self.total = self._counter("total")
+        batch_correct = self.helper.create_tmp_variable(
+            dtype="int32", stop_gradient=True)
+        batch_total = self.helper.create_tmp_variable(
+            dtype="int32", stop_gradient=True)
+        batch_acc = layers.accuracy(input=input, label=label, k=k,
+                                    correct=batch_correct,
+                                    total=batch_total)
+        self._accumulate(self.correct, batch_correct)
+        self._accumulate(self.total, batch_total)
+        self.metrics.append(batch_acc)
 
-        self.total = self.create_state(dtype="int32", shape=[1],
-                                       suffix="total")
-        self.correct = self.create_state(dtype="int32", shape=[1],
-                                         suffix="correct")
-        total = self.helper.create_tmp_variable(dtype="int32",
-                                                stop_gradient=True)
-        correct = self.helper.create_tmp_variable(dtype="int32",
-                                                  stop_gradient=True)
-        acc = layers.accuracy(input=input, label=label, k=k,
-                              correct=correct, total=total)
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.total, total]},
-            outputs={"Out": [self.total]})
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.correct, correct]},
-            outputs={"Out": [self.correct]})
-        self.metrics.append(acc)
-        self.states.extend([self.total, self.correct])
-
-    def eval(self, executor, eval_program=None):
-        if eval_program is None:
-            eval_program = Program()
-        block = eval_program.current_block()
-        with framework.program_guard(main_program=eval_program):
-            total = _clone_var_(block, self.total)
-            correct = _clone_var_(block, self.correct)
-            total = layers.cast(total, dtype="float32")
-            correct = layers.cast(correct, dtype="float32")
-            out = layers.elementwise_div(x=correct, y=total)
-        return np.array(executor.run(eval_program, fetch_list=[out])[0])
+    def _combine(self, reads):
+        correct, total = (r.sum() for r in reads)
+        return np.array([_ratio(correct, total)], np.float32)
 
 
 class ChunkEvaluator(Evaluator):
-    """Streaming chunk F1 (reference: evaluator.py ChunkEvaluator)."""
+    """Streaming chunk-level precision/recall/F1 (reference:
+    fluid/evaluator.py ChunkEvaluator over chunk_eval_op)."""
 
     def __init__(self, input, label, chunk_scheme, num_chunk_types,
                  excluded_chunk_types=None, **kwargs):
         super().__init__("chunk_eval", **kwargs)
-        main_program = self.helper.main_program
-        if main_program.current_block().idx != 0:
-            raise ValueError("You can only invoke Evaluator in root block")
+        self.num_infer = self._counter("infer_chunks")
+        self.num_label = self._counter("label_chunks")
+        self.num_correct = self._counter("correct_chunks")
+        (precision, recall, f1,
+         batch_infer, batch_label, batch_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self._accumulate(self.num_infer, batch_infer)
+        self._accumulate(self.num_label, batch_label)
+        self._accumulate(self.num_correct, batch_correct)
+        self.metrics.extend([precision, recall, f1])
 
-        self.num_infer_chunks = self.create_state(
-            dtype="int32", shape=[1], suffix="num_infer_chunks")
-        self.num_label_chunks = self.create_state(
-            dtype="int32", shape=[1], suffix="num_label_chunks")
-        self.num_correct_chunks = self.create_state(
-            dtype="int32", shape=[1], suffix="num_correct_chunks")
-        precision, recall, f1_score, num_infer_chunks, num_label_chunks, \
-            num_correct_chunks = layers.chunk_eval(
-                input=input, label=label, chunk_scheme=chunk_scheme,
-                num_chunk_types=num_chunk_types,
-                excluded_chunk_types=excluded_chunk_types)
-        self.helper.append_op(
-            type="sum",
-            inputs={"X": [self.num_infer_chunks, num_infer_chunks]},
-            outputs={"Out": [self.num_infer_chunks]})
-        self.helper.append_op(
-            type="sum",
-            inputs={"X": [self.num_label_chunks, num_label_chunks]},
-            outputs={"Out": [self.num_label_chunks]})
-        self.helper.append_op(
-            type="sum",
-            inputs={"X": [self.num_correct_chunks, num_correct_chunks]},
-            outputs={"Out": [self.num_correct_chunks]})
-        self.metrics.extend([precision, recall, f1_score])
-        self.states.extend([self.num_infer_chunks, self.num_label_chunks,
-                            self.num_correct_chunks])
-
-    def eval(self, executor, eval_program=None):
-        from ..core.scope import global_scope
-
-        num_infer = np.asarray(
-            global_scope().get(self.num_infer_chunks.name)).sum()
-        num_label = np.asarray(
-            global_scope().get(self.num_label_chunks.name)).sum()
-        num_correct = np.asarray(
-            global_scope().get(self.num_correct_chunks.name)).sum()
-        precision = float(num_correct) / num_infer if num_infer else 0.0
-        recall = float(num_correct) / num_label if num_label else 0.0
-        f1 = 2 * precision * recall / (precision + recall) \
-            if num_correct else 0.0
-        return np.array([precision]), np.array([recall]), np.array([f1])
+    def _combine(self, reads):
+        infer, label, correct = (r.sum() for r in reads)
+        precision = _ratio(correct, infer)
+        recall = _ratio(correct, label)
+        f1 = (2 * precision * recall / (precision + recall)
+              if correct else 0.0)
+        return (np.array([precision]), np.array([recall]),
+                np.array([f1]))
 
 
 class EditDistance(Evaluator):
-    """Streaming edit distance / CTC sequence error (reference:
-    gserver/evaluators/CTCErrorEvaluator.cpp — total edit distance,
-    instance error rate; fluid analog of the later EditDistance
-    metric).  `input` are hypothesis id sequences, `label` references."""
+    """Streaming edit distance / sequence error rate (reference:
+    gserver/evaluators/CTCErrorEvaluator.cpp — total edit distance and
+    instance error rate).  `input` are hypothesis id sequences, `label`
+    the references."""
 
     def __init__(self, input, label, ignored_tokens=None, **kwargs):
         super().__init__("edit_distance", **kwargs)
-        main_program = self.helper.main_program
-        if main_program.current_block().idx != 0:
-            raise ValueError("You can only invoke Evaluator in root block")
-
-        self.total_distance = self.create_state(
-            dtype="float32", shape=[1], suffix="total_distance")
-        self.seq_num = self.create_state(
-            dtype="int32", shape=[1], suffix="seq_num")
-        self.instance_error = self.create_state(
-            dtype="int32", shape=[1], suffix="instance_error")
-
-        dist, seq_num = layers.edit_distance(
+        self.total_distance = self._counter("total_distance", "float32")
+        self.seq_num = self._counter("seq_num")
+        self.wrong_seqs = self._counter("wrong_seqs")
+        dist, batch_seqs = layers.edit_distance(
             input=input, label=label, ignored_tokens=ignored_tokens)
         batch_dist = layers.reduce_sum(input=dist, dim=0, keep_dim=False)
-        # distances are >= 0, so sign(d) is the per-sequence wrong flag
-        wrong = layers.cast(
-            layers.reduce_sum(input=layers.sign(dist), dim=0,
-                              keep_dim=False), dtype="int32")
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.total_distance, batch_dist]},
-            outputs={"Out": [self.total_distance]})
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.seq_num, seq_num]},
-            outputs={"Out": [self.seq_num]})
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.instance_error, wrong]},
-            outputs={"Out": [self.instance_error]})
-        self.metrics.extend([dist])
-        self.states.extend([self.total_distance, self.seq_num,
-                            self.instance_error])
+        # distances are >= 0, so sign(d) flags each wrong sequence
+        batch_wrong = layers.reduce_sum(
+            input=layers.sign(dist), dim=0, keep_dim=False)
+        self._accumulate(self.total_distance, batch_dist)
+        self._accumulate(self.seq_num, batch_seqs)
+        self._accumulate(self.wrong_seqs, batch_wrong)
+        self.metrics.append(dist)
 
-    def eval(self, executor, eval_program=None):
-        from ..core.scope import global_scope
-
-        total = float(np.asarray(
-            global_scope().get(self.total_distance.name)).sum())
-        n = int(np.asarray(global_scope().get(self.seq_num.name)).sum())
-        wrong = int(np.asarray(
-            global_scope().get(self.instance_error.name)).sum())
-        avg = total / n if n else 0.0
-        err = wrong / n if n else 0.0
-        return np.array([avg]), np.array([err])
+    def _combine(self, reads):
+        total, n, wrong = (r.sum() for r in reads)
+        return (np.array([_ratio(total, n)]),
+                np.array([_ratio(wrong, n)]))
 
 
 class DetectionMAP(Evaluator):
@@ -212,10 +192,8 @@ class DetectionMAP(Evaluator):
                  background_id=0, ap_type="11point",
                  evaluate_difficult=False, **kwargs):
         super().__init__("detection_map", **kwargs)
-        self.map_sum = self.create_state(dtype="float32", shape=[1],
-                                         suffix="map_sum")
-        self.batches = self.create_state(dtype="float32", shape=[1],
-                                         suffix="batches")
+        self.map_sum = self._counter("map_sum", "float32")
+        self.batches = self._counter("batches", "float32")
         batch_map = self.helper.create_tmp_variable(
             dtype="float32", stop_gradient=True)
         self.helper.append_op(
@@ -226,19 +204,12 @@ class DetectionMAP(Evaluator):
                    "background_label_id": int(background_id),
                    "ap_type": ap_type,
                    "evaluate_difficult": bool(evaluate_difficult)})
-        one = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.map_sum, batch_map]},
-            outputs={"Out": [self.map_sum]})
-        self.helper.append_op(
-            type="sum", inputs={"X": [self.batches, one]},
-            outputs={"Out": [self.batches]})
+        self._accumulate(self.map_sum, batch_map)
+        self._accumulate(
+            self.batches,
+            layers.fill_constant(shape=[1], dtype="float32", value=1.0))
         self.metrics.append(batch_map)
-        self.states.extend([self.map_sum, self.batches])
 
-    def eval(self, executor, eval_program=None):
-        from ..core.scope import global_scope
-
-        s = float(np.asarray(global_scope().get(self.map_sum.name)).sum())
-        n = float(np.asarray(global_scope().get(self.batches.name)).sum())
-        return np.array([s / n if n else 0.0])
+    def _combine(self, reads):
+        map_sum, batches = (r.sum() for r in reads)
+        return np.array([_ratio(map_sum, batches)])

@@ -454,9 +454,9 @@ class _CompiledProgram:
 def guard_int64_narrowing(arr, name="feed"):
     """int64 host arrays execute as int32 (JAX x64 disabled).  Make the
     narrowing LOUD when it would actually wrap — embedding/beam ids
-    beyond 2^31 would silently corrupt lookups otherwise.  Shared by
-    the executor feed path and reader.device_prefetch (which
-    device_puts on a worker thread, before the executor sees it)."""
+    beyond 2^31 would silently corrupt lookups otherwise.  Used by the
+    executor feed path; reader.device_prefetch sidesteps the issue by
+    keeping int64 feeds on host (see reader/prefetch.py)."""
     if getattr(arr, "dtype", None) == np.int64 and arr.size \
             and (arr.max() > np.iinfo(np.int32).max
                  or arr.min() < np.iinfo(np.int32).min):

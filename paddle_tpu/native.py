@@ -73,6 +73,7 @@ def lib():
             "ptrt_pserver_load": (c.c_int, [c.c_void_p, c.c_char_p]),
             "ptrt_pserver_num_updates": (c.c_int64, [c.c_void_p]),
             "ptrt_pserver_num_lagged": (c.c_int64, [c.c_void_p]),
+            "ptrt_pserver_num_sparse_rows": (c.c_int64, [c.c_void_p]),
             "ptrt_client_connect": (c.c_void_p, [c.c_char_p, c.c_int]),
             "ptrt_client_close": (None, [c.c_void_p]),
             "ptrt_client_init_param":
@@ -157,6 +158,11 @@ class ParameterServer:
     def num_lagged(self):
         """Async gradients discarded by the staleness bound."""
         return lib().ptrt_pserver_num_lagged(self._h)
+
+    def num_sparse_rows(self):
+        """Total sparse rows applied via send_sparse_grad — proves the
+        embedding updates shipped as rows, not dense tensors."""
+        return lib().ptrt_pserver_num_sparse_rows(self._h)
 
     def save(self, path):
         return lib().ptrt_pserver_save(self._h, path.encode())

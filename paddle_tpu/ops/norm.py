@@ -24,15 +24,24 @@ def _bn_stats(x, axes):
     """Batch mean/var, always accumulated in f32 (XLA fuses the convert
     into the reduction, so a bf16 input is still read once at 2 B/elem).
 
-    One-pass form E[x^2] - E[x]^2: both reductions share a single sweep
-    over the activation (XLA fuses same-input reduces), where jnp.var's
-    two-pass (x - mean)^2 would read the big tensor twice.  Cancellation
-    is benign here: conv/fc outputs are roughly centered and the
-    accumulators are f32; the max(., 0) guards the round-off edge."""
+    Shifted one-pass form: with a per-channel reference value s,
+    var = E[(x-s)^2] - E[x-s]^2 and mean = E[x-s] + s.  Both reductions
+    still share a single sweep over the activation (XLA fuses same-input
+    reduces) — unlike jnp.var's two-pass (x - mean)^2 which reads the
+    big tensor twice — but the shift removes the catastrophic
+    cancellation of the naive E[x^2] - E[x]^2 when |mean| >> std (e.g.
+    a first BN over raw 0-255 inputs).  s is the channel's first
+    element: free to read, and any value near the data keeps the
+    cancellation benign; max(., 0) guards the round-off edge."""
     xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
-    m = jnp.mean(xs, axis=axes)
-    msq = jnp.mean(jnp.square(xs), axis=axes)
-    return m, jnp.maximum(msq - jnp.square(m), 0.0)
+    first = tuple(slice(0, 1) if i in axes else slice(None)
+                  for i in range(x.ndim))
+    shift = jax.lax.stop_gradient(xs[first])
+    d = xs - shift
+    dm = jnp.mean(d, axis=axes)
+    dsq = jnp.mean(jnp.square(d), axis=axes)
+    var = jnp.maximum(dsq - jnp.square(dm), 0.0)
+    return dm + jnp.reshape(shift, dm.shape), var
 
 
 def _bn_normalize(x, scale, bias, m, v, eps, bshape):

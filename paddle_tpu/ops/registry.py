@@ -31,12 +31,12 @@ from ..core.types import is_float_dtype, np_dtype, GRAD_SUFFIX, VarType
 class OpInfo:
     __slots__ = ("type", "kernel", "infer_shape", "grad_maker", "grad_kernel",
                  "jittable", "uses_rng", "nondiff_inputs", "stop_gradient_op",
-                 "in_place_outputs")
+                 "in_place_outputs", "sparse_grad_slots")
 
     def __init__(self, type, kernel=None, infer_shape=None, grad_maker=None,
                  grad_kernel=None, jittable=True, uses_rng=False,
                  nondiff_inputs=(), stop_gradient_op=False,
-                 in_place_outputs=()):
+                 in_place_outputs=(), sparse_grad_slots=None):
         self.type = type
         self.kernel = kernel
         self.infer_shape = infer_shape
@@ -48,6 +48,10 @@ class OpInfo:
         self.stop_gradient_op = stop_gradient_op     # no grads flow at all
         # slots whose output aliases an input (optimizer ops: ParamOut=Param)
         self.in_place_outputs = tuple(in_place_outputs)
+        # fn(attrs) -> forward-input slots whose grad is a SelectedRows;
+        # the backward builder types those grad VarDescs accordingly
+        # (reference: lookup_table_op.cc LookupTableOpGradVarTypeInference)
+        self.sparse_grad_slots = sparse_grad_slots
 
 
 _OP_REGISTRY = {}

@@ -12,7 +12,9 @@ from .registry import register_op, register_grad_kernel
 from ..core.ragged import RaggedTensor, SelectedRows
 
 
-@register_op("lookup_table", nondiff_inputs=("Ids",))
+@register_op("lookup_table", nondiff_inputs=("Ids",),
+             sparse_grad_slots=lambda attrs:
+                 ("W",) if attrs.get("is_sparse") else ())
 def lookup_table(ctx, ins, attrs):
     w = ins["W"][0]
     ids = ins["Ids"][0]

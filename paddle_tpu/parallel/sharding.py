@@ -79,8 +79,35 @@ _ACC_NAME = _re.compile(
     r"_(velocity|moment[12]?|inf_norm|avg_squared_grad|"
     r"avg_squared_update|mean_square|squared|linear)_\d+$")
 
+_OPTIMIZER_OPS = frozenset([
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad"])
 
-def is_optimizer_state(name):
+# optimizer-op input slots that are NOT accumulator state
+_NON_STATE_SLOTS = frozenset(["Param", "Grad", "LearningRate"])
+
+
+def optimizer_state_names(program):
+    """The exact accumulator var names of a built program: every input
+    to an optimizer op except Param/Grad/LearningRate.  Exact where the
+    name-suffix regex is a guess (a user var named '*_squared_3' would
+    fool the regex but can never appear in an optimizer slot)."""
+    names = set()
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type not in _OPTIMIZER_OPS:
+                continue
+            for slot, vars_ in op.desc.inputs.items():
+                if slot not in _NON_STATE_SLOTS:
+                    names.update(vars_)
+    return names
+
+
+def is_optimizer_state(name, known=None):
+    """`known` (from optimizer_state_names) is authoritative; the name
+    regex is the fallback for detached state dicts with no program."""
+    if known is not None:
+        return name in known
     return bool(_ACC_NAME.search(name))
 
 

@@ -15,7 +15,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..jit import FunctionalProgram, state_from_scope
 from .sharding import (param_spec, batch_spec, is_optimizer_state,
-                       zero1_spec)
+                       optimizer_state_names, zero1_spec)
 
 __all__ = ["make_parallel_step", "ParallelTrainer"]
 
@@ -38,9 +38,14 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
     if fp is None:
         fp = FunctionalProgram(program, feed_names, fetch_names)
 
+    # exact accumulator names from the program's optimizer ops (the
+    # name-suffix regex stays only for detached state dicts)
+    acc_names = optimizer_state_names(program) if program is not None \
+        else None
+
     def spec_for(name, shape):
         spec = param_spec(name, shape, mesh, mp_axis=mp_axis)
-        if zero_stage >= 1 and is_optimizer_state(name):
+        if zero_stage >= 1 and is_optimizer_state(name, known=acc_names):
             spec = zero1_spec(spec, shape, mesh, dp_axis=dp_axis)
         return spec
 

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Round-long TPU capture loop.
+#
+# The claim behaves badly when the tunnel is wedged: it BLOCKS (observed
+# ~100 min) and then fails with UNAVAILABLE; killing a claim mid-flight
+# can re-wedge the grant.  So this watcher uses ONE patient probe per
+# attempt with a very generous timeout (the probe itself is the wait),
+# never a tight kill-retry loop.  The moment a probe succeeds, it
+# captures the full measurement suite; each bench run persists itself to
+# BENCH_LAST_TPU.json so the driver's end-of-round bench.py can never
+# lose the numbers.
+#
+# Status lands in docs/tpu_watch.log; docs/TPU_CAPTURED_OK marks a
+# complete suite.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+log="docs/tpu_watch.log"
+probe_timeout="${WATCH_PROBE_TIMEOUT:-7200}"
+
+say() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$log"; }
+
+bench_one() {  # bench_one <label> [ENV=VAL ...]
+  local label="$1"; shift
+  say "bench $label ..."
+  if env BENCH_CLAIM_TIMEOUT=0 "$@" timeout 2400 python bench.py \
+      >>"$log" 2>&1; then
+    say "bench $label OK: $(tail -1 "$log" >/dev/null; grep -o '"value": [0-9.]*' "$log" | tail -1)"
+  else
+    say "bench $label FAILED (rc=$?)"
+    return 1
+  fi
+}
+
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  say "attempt $attempt: patient claim probe (up to ${probe_timeout}s)"
+  if timeout "$probe_timeout" python -c \
+      "import jax; print(jax.devices(), flush=True)" >>"$log" 2>&1; then
+    say "claim OK — capturing measurement suite"
+    ok=1
+    bench_one "resnet50-b128" BENCH_MODEL=resnet50 BENCH_BATCH=128 || ok=0
+    bench_one "resnet50-b256" BENCH_MODEL=resnet50 BENCH_BATCH=256 || ok=0
+    bench_one "vgg16-b128"    BENCH_MODEL=vgg16 BENCH_BATCH=128 || ok=0
+    bench_one "lstm-b256-h256" BENCH_MODEL=lstm BENCH_BATCH=256 \
+      BENCH_HIDDEN=256 || ok=0
+    bench_one "alexnet-b128"  BENCH_MODEL=alexnet BENCH_BATCH=128 || ok=0
+    bench_one "googlenet-b128" BENCH_MODEL=googlenet BENCH_BATCH=128 || ok=0
+    bench_one "resnet50-b128-f32" BENCH_MODEL=resnet50 BENCH_BATCH=128 \
+      BENCH_AMP=0 || ok=0
+    say "profiling ..."
+    env PROFILE_STEPS=10 timeout 2400 python scripts/profile_tpu.py \
+      >>"$log" 2>&1 && say "profile OK" || say "profile FAILED"
+    if [ "$ok" = 1 ]; then
+      date > docs/TPU_CAPTURED_OK
+      say "suite complete — exiting"
+      exit 0
+    fi
+    say "suite incomplete; retrying after 600s"
+    sleep 600
+  else
+    say "claim failed/timed out; next patient probe after 300s"
+    sleep 300
+  fi
+done

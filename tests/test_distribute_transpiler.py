@@ -209,6 +209,66 @@ def test_multiprocess_roles():
     assert rcs == [0, 0], rcs
 
 
+def test_async_sgd_convergence_and_staleness():
+    """Async-SGD through the transpiler (reference:
+    ParameterServer2.h asyncSGD:468): gradients apply immediately with
+    no cross-trainer barrier, a staleness bound discards gradients
+    computed against parameters >= N versions old
+    (ParameterServer2.h:243), and training still converges."""
+    server = native.ParameterServer(num_trainers=2, sync=False,
+                                    async_lagged_threshold=4)
+    try:
+        endpoint = "127.0.0.1:%d" % server.port
+        x, y, avg_cost, optimize_ops, params_grads = _build_fit_a_line()
+        t = DistributeTranspiler()
+        t.transpile(optimize_ops=optimize_ops, params_grads=params_grads,
+                    pservers=endpoint, trainers=2, sync_mode=False)
+        assert t.sync is False
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        exe.run(fluid.default_startup_program())
+        t.init_pservers()
+
+        feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+        reader = paddle.batch(paddle.dataset.uci_housing.train(),
+                              batch_size=20)
+        losses = []
+        for _ in range(8):
+            for data in reader():
+                out, = exe.run(fluid.default_main_program(),
+                               feed=feeder.feed(data),
+                               fetch_list=[avg_cost])
+                losses.append(float(np.asarray(out).reshape(-1)[0]))
+        # async single-trainer traffic converges like sync
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        assert server.num_updates() > 0
+        assert server.num_lagged() == 0
+
+        # deterministic staleness: a second client whose view of one
+        # block is now 5+ versions behind gets its gradient discarded
+        pname = next(iter(t.param_blocks))
+        _ep, begin, size = t.param_blocks[pname][0]
+        bname = "%s@%d" % (pname, begin)
+        lagger = native.PServerClient("127.0.0.1", server.port)
+        lagger.get_param(bname, size)          # records current version
+        fresh = native.PServerClient("127.0.0.1", server.port)
+        fresh.get_param(bname, size)
+        for _ in range(5):                     # bump 5 versions
+            fresh.send_grad(bname, np.zeros(size, np.float32))
+        lagger.send_grad(bname, np.zeros(size, np.float32))
+        assert not lagger.last_grad_applied    # discarded as stale
+        assert server.num_lagged() >= 1
+        # the stale trainer resynchronized: its next grad applies
+        lagger.send_grad(bname, np.zeros(size, np.float32))
+        assert lagger.last_grad_applied
+        lagger.close()
+        fresh.close()
+    finally:
+        ClientPool.reset()
+        server.stop()
+
+
 def test_lr_decay_warning():
     """An op writing the optimizer's LR var after transpile means the
     pserver's snapshotted LR goes stale — transpile must warn."""
