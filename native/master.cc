@@ -27,12 +27,26 @@ enum Op : uint32_t {
   kGetTask = 21,
   kTaskFinished = 22,
   kTaskFailed = 23,
+  // etcd-style TTL-lease registry (reference:
+  // go/pserver/etcd_client.go:31-97 — pserver slot registration with
+  // TTL keep-alive; trainers discover live pservers by listing)
+  kRegister = 24,
+  kKeepAlive = 25,
+  kUnregister = 26,
+  kList = 27,
 };
 
 struct Task {
   int64_t id = 0;
   std::vector<std::string> chunks;
   int failures = 0;
+};
+
+struct Lease {
+  std::string key;
+  std::string value;
+  int ttl_ms = 0;
+  std::chrono::steady_clock::time_point deadline;
 };
 
 using Clock = std::chrono::steady_clock;
@@ -129,6 +143,21 @@ class Master {
   }
 
  private:
+  void expireLeasesLocked(Clock::time_point now) {
+    for (auto it = leases_.begin(); it != leases_.end();) {
+      if (now >= it->second.deadline)
+        it = leases_.erase(it);
+      else
+        ++it;
+    }
+  }
+
+  bool keyHeldLocked(const std::string &key, Clock::time_point now) {
+    for (auto &kv : leases_)
+      if (kv.second.key == key && now < kv.second.deadline) return true;
+    return false;
+  }
+
   void timeoutLoop() {
     // requeue leased tasks whose lease expired (reference:
     // go/master checkTimeoutFunc:341)
@@ -137,6 +166,7 @@ class Master {
         std::lock_guard<std::mutex> g(mu_);
         if (stopping_) return;
         auto now = Clock::now();
+        expireLeasesLocked(now);
         for (auto it = pending_.begin(); it != pending_.end();) {
           auto age = std::chrono::duration_cast<std::chrono::milliseconds>(
                          now - it->second.second)
@@ -241,6 +271,71 @@ class Master {
         w.u32(0);
         break;
       }
+      case kRegister: {
+        std::string key = r.str();
+        std::string value = r.str();
+        int ttl_ms = static_cast<int>(r.u32());
+        std::lock_guard<std::mutex> g(mu_);
+        auto now = Clock::now();
+        expireLeasesLocked(now);
+        if (keyHeldLocked(key, now)) {
+          // slot taken by a live lease (reference: etcd CAS on the
+          // pserver index key — the caller retries another slot or
+          // waits for the TTL to lapse)
+          w.u32(1);
+          return;
+        }
+        Lease l;
+        l.key = std::move(key);
+        l.value = std::move(value);
+        l.ttl_ms = std::max(1, ttl_ms);
+        l.deadline = now + std::chrono::milliseconds(l.ttl_ms);
+        int64_t id = next_lease_++;
+        leases_[id] = std::move(l);
+        w.u32(0);
+        w.i64(id);
+        break;
+      }
+      case kKeepAlive: {
+        int64_t id = r.i64();
+        std::lock_guard<std::mutex> g(mu_);
+        auto now = Clock::now();
+        auto it = leases_.find(id);
+        if (it == leases_.end() || now >= it->second.deadline) {
+          if (it != leases_.end()) leases_.erase(it);
+          w.u32(1);  // lease lapsed: the holder must re-register
+          return;
+        }
+        it->second.deadline =
+            now + std::chrono::milliseconds(it->second.ttl_ms);
+        w.u32(0);
+        break;
+      }
+      case kUnregister: {
+        int64_t id = r.i64();
+        std::lock_guard<std::mutex> g(mu_);
+        leases_.erase(id);
+        w.u32(0);
+        break;
+      }
+      case kList: {
+        std::string prefix = r.str();
+        std::lock_guard<std::mutex> g(mu_);
+        auto now = Clock::now();
+        expireLeasesLocked(now);
+        std::vector<std::pair<std::string, std::string>> out;
+        for (auto &kv : leases_)
+          if (kv.second.key.compare(0, prefix.size(), prefix) == 0)
+            out.emplace_back(kv.second.key, kv.second.value);
+        std::sort(out.begin(), out.end());
+        w.u32(0);
+        w.u64(out.size());
+        for (auto &p : out) {
+          w.str(p.first);
+          w.str(p.second);
+        }
+        break;
+      }
       default:
         w.u32(0xFFFF);
     }
@@ -254,6 +349,8 @@ class Master {
   std::vector<Task> todo_, done_, discarded_;
   std::map<int64_t, std::pair<Task, Clock::time_point>> pending_;
   int64_t next_id_ = 0;
+  std::map<int64_t, Lease> leases_;
+  int64_t next_lease_ = 1;
   std::thread timeout_thread_;
   Server server_;
 };
@@ -335,6 +432,63 @@ int ptrt_mclient_task_failed(void *c, int64_t task_id) {
   w.i64(task_id);
   std::vector<uint8_t> resp;
   return static_cast<Client *>(c)->call(kTaskFailed, w, &resp) ? 0 : -1;
+}
+
+int64_t ptrt_mclient_register(void *c, const char *key, const char *value,
+                              int ttl_ms) {
+  Writer w;
+  w.str(key);
+  w.str(value);
+  w.u32(static_cast<uint32_t>(ttl_ms));
+  std::vector<uint8_t> resp;
+  if (!static_cast<Client *>(c)->call(kRegister, w, &resp)) return -2;
+  Reader r(resp.data(), resp.size());
+  if (r.u32() != 0) return -1;  // key held by a live lease
+  return r.i64();
+}
+
+int ptrt_mclient_keepalive(void *c, int64_t lease) {
+  Writer w;
+  w.i64(lease);
+  std::vector<uint8_t> resp;
+  if (!static_cast<Client *>(c)->call(kKeepAlive, w, &resp)) return -2;
+  Reader r(resp.data(), resp.size());
+  return static_cast<int>(r.u32());  // 0 renewed, 1 lapsed
+}
+
+int ptrt_mclient_unregister(void *c, int64_t lease) {
+  Writer w;
+  w.i64(lease);
+  std::vector<uint8_t> resp;
+  return static_cast<Client *>(c)->call(kUnregister, w, &resp) ? 0 : -1;
+}
+
+int64_t ptrt_mclient_list(void *c, const char *prefix, char *buf,
+                          int64_t buflen) {
+  // entries come back newline-joined as "key=value" lines; returns the
+  // entry count, or -4 when the buffer would truncate
+  Writer w;
+  w.str(prefix);
+  std::vector<uint8_t> resp;
+  if (!static_cast<Client *>(c)->call(kList, w, &resp)) return -2;
+  Reader r(resp.data(), resp.size());
+  if (r.u32() != 0) return -1;
+  uint64_t n = r.u64();
+  std::string joined;
+  for (uint64_t i = 0; i < n; ++i) {
+    std::string k = r.str();
+    std::string v = r.str();
+    if (i) joined += "\n";
+    joined += k;
+    joined += "=";
+    joined += v;
+  }
+  if (buf && buflen > 0) {
+    if (joined.size() > static_cast<size_t>(buflen - 1)) return -4;
+    memcpy(buf, joined.data(), joined.size());
+    buf[joined.size()] = 0;
+  }
+  return static_cast<int64_t>(n);
 }
 
 }  // extern "C"

@@ -81,6 +81,13 @@ int ptrt_mclient_set_dataset(void *c, const char *const *chunks, int n,
 int64_t ptrt_mclient_get_task(void *c, char *buf, int64_t buflen);
 int ptrt_mclient_task_finished(void *c, int64_t task_id);
 int ptrt_mclient_task_failed(void *c, int64_t task_id);
+/* etcd-style TTL-lease registry (pserver registration/discovery) */
+int64_t ptrt_mclient_register(void *c, const char *key, const char *value,
+                              int ttl_ms);
+int ptrt_mclient_keepalive(void *c, int64_t lease); /* 0 ok, 1 lapsed */
+int ptrt_mclient_unregister(void *c, int64_t lease);
+int64_t ptrt_mclient_list(void *c, const char *prefix, char *buf,
+                          int64_t buflen);
 
 /* ---- recordio --------------------------------------------------------- */
 void *ptrt_recordio_writer_open(const char *path);

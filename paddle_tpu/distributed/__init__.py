@@ -16,8 +16,8 @@ from .transpiler import (DistributeTranspiler, split_dense_variable,
                          run_pserver)
 
 from .coordinator import (init_multihost, global_mesh, process_count,
-                          process_index)
+                          process_index, ElasticRegistry, ServiceLease)
 
 __all__ = ["DistributeTranspiler", "split_dense_variable", "run_pserver",
            "init_multihost", "global_mesh", "process_count",
-           "process_index"]
+           "process_index", "ElasticRegistry", "ServiceLease"]

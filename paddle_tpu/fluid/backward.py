@@ -292,15 +292,29 @@ def _apply_sparse_grad_types(block, op_desc):
     descs default to mirroring the dense forward var).  Driven by the
     forward op's registry hook — reference: the per-op VarTypeInference
     pass, e.g. lookup_table_op.cc marking W@GRAD as SelectedRows when
-    is_sparse."""
+    is_sparse.  Grad-accumulation `sum` ops propagate the typing: the
+    sum of all-SelectedRows contributions is a SelectedRows (rows
+    concatenated, reference: sum_op.cc SelectedRows path), so a table
+    looked up more than once still routes sparse."""
+    from ..core.types import VarType
+
+    if op_desc.type == "sum":
+        in_names = [n for n in op_desc.input("X") if n != EMPTY]
+        in_descs = [block.desc.vars.get(n) for n in in_names]
+        if in_descs and all(
+                vd is not None and vd.type == VarType.SELECTED_ROWS
+                for vd in in_descs):
+            for n in op_desc.output("Out"):
+                vd = block.desc.vars.get(n)
+                if vd is not None:
+                    vd.type = VarType.SELECTED_ROWS
+        return
     if not op_registry.is_grad_op_type(op_desc.type):
         return
     info = _op_info_for(op_registry.forward_type_of_grad(op_desc.type))
     hook = info.sparse_grad_slots
     if hook is None:
         return
-    from ..core.types import VarType
-
     for slot in hook(op_desc.attrs):
         for n in op_desc.outputs.get(slot + GRAD_SUFFIX, []):
             if n == EMPTY:

@@ -25,7 +25,7 @@ __all__ = [
     "dynamic_lstm", "dynamic_gru", "gru_unit", "sequence_softmax",
     "sequence_slice", "lod_reset", "edit_distance", "ctc_greedy_decoder",
     "sequence_concat", "beam_search", "beam_search_decode",
-    "sequence_reverse",
+    "sequence_reverse", "sequence_unnest", "sequence_renest",
 ]
 
 
@@ -679,7 +679,10 @@ def split(input, num_or_sections, dim=-1, **kwargs):
     else:
         num = len(num_or_sections)
         sections = list(num_or_sections)
-    outs = [helper.create_tmp_variable(input.dtype) for _ in range(num)]
+    outs = [helper.create_tmp_variable(input.dtype,
+                                       lod_level=input.lod_level
+                                       if dim != 0 else 0)
+            for _ in range(num)]
     helper.append_op(type="split", inputs={"X": [input]},
                      outputs={"Out": outs},
                      attrs={"axis": dim, "sections": sections, "num":
@@ -771,6 +774,33 @@ def sequence_expand(x, y, **kwargs):
     out = helper.create_tmp_variable(x.dtype, lod_level=y.lod_level)
     helper.append_op(type="sequence_expand",
                      inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    return out
+
+
+def sequence_unnest(x, **kwargs):
+    """Flatten a nested (lod_level-2) sequence's outer level into the
+    batch: returns (inner, outer_ref) where `inner` is the lod-1 batch
+    of all subsequences and `outer_ref` carries the outer row_splits for
+    sequence_renest (the compiled lowering of the reference's
+    nested-sequence mode, RecurrentGradientMachine.h:32)."""
+    helper = LayerHelper("sequence_unnest", input=x, **kwargs)
+    inner = helper.create_tmp_variable(x.dtype, lod_level=1)
+    outer_ref = helper.create_tmp_variable("float32", lod_level=1)
+    helper.append_op(type="seq_unnest", inputs={"X": [x]},
+                     outputs={"Inner": [inner], "OuterRef": [outer_ref]})
+    return inner, outer_ref
+
+
+def sequence_renest(x, outer_ref, **kwargs):
+    """Reattach outer row_splits dropped by sequence_unnest: dense
+    per-subsequence rows become a sentence-level lod-1 sequence; a
+    lod-1 ragged becomes the full lod-2 nested sequence."""
+    helper = LayerHelper("sequence_renest", input=x, **kwargs)
+    lod = 2 if x.lod_level else 1
+    out = helper.create_tmp_variable(x.dtype, lod_level=lod)
+    helper.append_op(type="seq_renest",
+                     inputs={"X": [x], "OuterRef": [outer_ref]},
+                     outputs={"Out": [out]})
     return out
 
 

@@ -47,7 +47,11 @@ def cast(x, dtype, **kwargs):
 
 def concat(input, axis=0, **kwargs):
     helper = LayerHelper("concat", **kwargs)
-    out = helper.create_tmp_variable(helper.input_dtype)
+    # feature-axis concat of ragged sequences stays ragged; axis-0
+    # concat flattens to dense (sequence_concat is the ragged axis-0 op)
+    lod = 0 if axis == 0 else max(getattr(x, "lod_level", 0)
+                                  for x in input)
+    out = helper.create_tmp_variable(helper.input_dtype, lod_level=lod)
     helper.append_op(type="concat", inputs={"X": input},
                      outputs={"Out": [out]}, attrs={"axis": axis})
     return out

@@ -108,6 +108,13 @@ def lib():
                 (c.c_int64, [c.c_void_p, c.c_char_p, c.c_int64]),
             "ptrt_mclient_task_finished": (c.c_int, [c.c_void_p, c.c_int64]),
             "ptrt_mclient_task_failed": (c.c_int, [c.c_void_p, c.c_int64]),
+            "ptrt_mclient_register":
+                (c.c_int64, [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]),
+            "ptrt_mclient_keepalive": (c.c_int, [c.c_void_p, c.c_int64]),
+            "ptrt_mclient_unregister": (c.c_int, [c.c_void_p, c.c_int64]),
+            "ptrt_mclient_list":
+                (c.c_int64, [c.c_void_p, c.c_char_p, c.c_char_p,
+                             c.c_int64]),
             "ptrt_recordio_writer_open": (c.c_void_p, [c.c_char_p]),
             "ptrt_recordio_write":
                 (c.c_int, [c.c_void_p, c.c_void_p, c.c_int64]),
@@ -320,6 +327,44 @@ class MasterClient:
 
     def task_failed(self, task_id):
         lib().ptrt_mclient_task_failed(self._h, task_id)
+
+    # -- TTL-lease registry (reference: go/pserver/etcd_client.go) ------
+
+    def register(self, key, value, ttl_ms):
+        """Claim `key` with a TTL lease; returns the lease id, or None
+        if a live lease already holds the key."""
+        lease = lib().ptrt_mclient_register(self._h, key.encode(),
+                                            value.encode(), int(ttl_ms))
+        if lease == -2:
+            raise ConnectionError("master unreachable")
+        return None if lease < 0 else lease
+
+    def keep_alive(self, lease):
+        """Renew; returns False when the lease already lapsed (the
+        holder must re-register)."""
+        rc = lib().ptrt_mclient_keepalive(self._h, int(lease))
+        if rc == -2:
+            raise ConnectionError("master unreachable")
+        return rc == 0
+
+    def unregister(self, lease):
+        lib().ptrt_mclient_unregister(self._h, int(lease))
+
+    def list_prefix(self, prefix):
+        """{key: value} of unexpired leases under `prefix`."""
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = lib().ptrt_mclient_list(self._h, prefix.encode(), buf,
+                                    len(buf))
+        if n == -2:
+            raise ConnectionError("master unreachable")
+        if n < 0:
+            raise RuntimeError("list_prefix rc=%d" % n)
+        out = {}
+        if buf.value:
+            for line in buf.value.decode().split("\n"):
+                k, _, v = line.partition("=")
+                out[k] = v
+        return out
 
     def close(self):
         if self._h:

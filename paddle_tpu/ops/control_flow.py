@@ -332,78 +332,158 @@ from ..core.ragged import RaggedTensor
 from ..core.rank_table import LoDRankTable
 
 
-def _lengths_of(x):
-    import numpy as _np
-
-    return _np.asarray(x.seq_lengths(0)).tolist()
-
-
 @register_op("lod_rank_table", stop_gradient_op=True, jittable=False)
 def lod_rank_table(ctx, ins, attrs):
-    """reference: lod_rank_table_op.cc — sort sequences by length desc.
-    Restricted to lod_level-1 inputs: the downstream kernels
-    (lod_tensor_to_array etc.) slice the deepest split level, which for
-    multi-level LoD would mix levels silently."""
+    """reference: lod_rank_table_op.cc — sort level-`level` sequences by
+    length descending.  For a nested (lod_level-2) input at level 0 the
+    "length" of an outer sequence is its subsequence count, matching the
+    reference's nested DynamicRNN semantics
+    (RecurrentGradientMachine.h:32): each RNN step then consumes one
+    whole subsequence per active outer sequence."""
     x = ins["X"][0]
     level = int(attrs.get("level", 0))
-    if x.lod_level != 1 or level != 0:
+    if not 0 <= level < x.lod_level:
+        raise ValueError(
+            "lod_rank_table level %d out of range for lod_level %d"
+            % (level, x.lod_level))
+    if x.lod_level > 2:
+        # the downstream array kernels slice exactly two levels; fail
+        # loudly rather than mix levels silently
         raise NotImplementedError(
-            "lod_rank_table supports lod_level-1 inputs at level 0 "
-            "(got lod_level=%d, level=%d)" % (x.lod_level, level))
-    return {"Out": [LoDRankTable.from_lengths(_lengths_of(x))]}
+            "rank-table machinery supports lod_level 1 and 2 inputs "
+            "(got %d)" % x.lod_level)
+    lengths = np.asarray(x.seq_lengths(level)).tolist()
+    return {"Out": [LoDRankTable.from_lengths(lengths)]}
+
+
+def _outer_item_bounds(x, i):
+    """Row range [begin, end) of outer sequence `i`'s values, resolving
+    through all deeper split levels."""
+    begin, end = i, i + 1
+    for rs in x.row_splits:
+        rs = np.asarray(rs)
+        begin, end = int(rs[begin]), int(rs[end])
+    return begin, end
 
 
 @register_op("reorder_lod_tensor_by_rank", stop_gradient_op=True,
              jittable=False)
 def reorder_lod_tensor_by_rank(ctx, ins, attrs):
     """reference: reorder_lod_tensor_by_rank_op.cc — permute X's
-    sequences into the rank table's order."""
+    level-0 sequences into the rank table's order; deeper LoD levels
+    travel with their outer sequence."""
     x = ins["X"][0]
     table = ins["RankTable"][0]
     vals = np.asarray(x.values)
-    splits = np.asarray(x.row_splits[-1])
-    out_rows, new_splits = [], [0]
+    n_levels = len(x.row_splits)
+    if n_levels > 2:
+        raise NotImplementedError(
+            "reorder_lod_tensor_by_rank supports lod_level 1 and 2 "
+            "inputs (got %d)" % n_levels)
+    out_rows = []
+    # per-level lengths of the permuted sequences
+    level_lengths = [[] for _ in range(n_levels)]
+    inner = np.asarray(x.row_splits[-1])
+    outer = np.asarray(x.row_splits[0])
     for i in table.indices():
-        out_rows.append(vals[splits[i]:splits[i + 1]])
-        new_splits.append(new_splits[-1] + (splits[i + 1] - splits[i]))
+        b, e = _outer_item_bounds(x, i)
+        out_rows.append(vals[b:e])
+        level_lengths[0].append(
+            int(outer[i + 1]) - int(outer[i]))
+        if n_levels == 2:
+            level_lengths[1].extend(
+                int(inner[j + 1]) - int(inner[j])
+                for j in range(int(outer[i]), int(outer[i + 1])))
     out = np.concatenate(out_rows, 0) if out_rows else vals[:0]
-    return {"Out": [RaggedTensor(jnp.asarray(out),
-                                 [np.asarray(new_splits, np.int32)])]}
+    splits = [np.cumsum([0] + ls).astype(np.int32)
+              for ls in level_lengths]
+    return {"Out": [RaggedTensor(jnp.asarray(out), splits)]}
 
 
 @register_op("lod_tensor_to_array", stop_gradient_op=True, jittable=False)
 def lod_tensor_to_array(ctx, ins, attrs):
-    """reference: lod_tensor_to_array_op.cc — per-timestep dense slices
-    in rank-table order (step t holds the t-th element of every
-    sequence still active at t)."""
+    """reference: lod_tensor_to_array_op.cc — per-timestep slices in
+    rank-table order.  lod_level-1 input: step t is a dense batch of
+    the t-th element of every still-active sequence.  lod_level-2
+    input: step t is a lod_level-1 RaggedTensor holding the t-th
+    SUBSEQUENCE of every still-active outer sequence (the reference's
+    nested-sequence step unit)."""
     x = ins["X"][0]
     table = ins["RankTable"][0]
+    if x.lod_level > 2:
+        raise NotImplementedError(
+            "lod_tensor_to_array supports lod_level 1 and 2 inputs "
+            "(got %d)" % x.lod_level)
     vals = np.asarray(x.values)
-    splits = np.asarray(x.row_splits[-1])
     steps = []
+    if x.lod_level <= 1:
+        splits = np.asarray(x.row_splits[-1])
+        for t in range(table.max_len()):
+            rows = [vals[splits[i] + t]
+                    for i, n in table.items if n > t]
+            steps.append(jnp.asarray(np.stack(rows, 0)))
+        return {"Out": [steps]}
+
+    outer = np.asarray(x.row_splits[0])
+    inner = np.asarray(x.row_splits[1])
     for t in range(table.max_len()):
-        rows = [vals[splits[i] + t]
-                for i, n in table.items if n > t]
-        steps.append(jnp.asarray(np.stack(rows, 0)))
+        rows, lengths = [], []
+        for i, n in table.items:
+            if n <= t:
+                continue
+            sub = int(outer[i]) + t
+            b, e = int(inner[sub]), int(inner[sub + 1])
+            rows.append(vals[b:e])
+            lengths.append(e - b)
+        step_vals = np.concatenate(rows, 0) if rows else vals[:0]
+        steps.append(RaggedTensor(
+            jnp.asarray(step_vals),
+            [np.cumsum([0] + lengths).astype(np.int32)]))
     return {"Out": [steps]}
 
 
 @register_op("array_to_lod_tensor", stop_gradient_op=True, jittable=False)
 def array_to_lod_tensor(ctx, ins, attrs):
     """reference: array_to_lod_tensor_op.cc — inverse of
-    lod_tensor_to_array."""
+    lod_tensor_to_array (both the dense-step and the nested
+    ragged-step forms)."""
     steps = ins["X"][0]
     table = ins["RankTable"][0]
-    seqs = {i: [] for i, _ in table.items}
+    nested = any(isinstance(s, RaggedTensor) for s in steps)
+    seqs = {i: [] for i, _ in table.items}       # per outer seq, per t
+    sub_lengths = {i: [] for i, _ in table.items}
     for t, arr in enumerate(steps):
-        arr = np.asarray(arr)
-        row = 0
-        for i, n in table.items:
-            if n > t:
-                seqs[i].append(arr[row])
-                row += 1
+        if nested:
+            svals = np.asarray(arr.values)
+            ssplits = np.asarray(arr.row_splits[-1])
+            pos = 0
+            for i, n in table.items:
+                if n > t:
+                    b, e = int(ssplits[pos]), int(ssplits[pos + 1])
+                    seqs[i].append(svals[b:e])
+                    sub_lengths[i].append(e - b)
+                    pos += 1
+        else:
+            arr = np.asarray(arr)
+            row = 0
+            for i, n in table.items:
+                if n > t:
+                    seqs[i].append(arr[row])
+                    row += 1
     # output stays in rank-table order (the reference's RNN in/out
     # convention: reorder_lod_tensor_by_rank restores original order)
+    if nested:
+        out_rows, outer_lengths, inner_lengths = [], [], []
+        for i, n in table.items:
+            out_rows.extend(seqs[i])
+            outer_lengths.append(n)
+            inner_lengths.extend(sub_lengths[i])
+        out = (np.concatenate(out_rows, 0) if out_rows
+               else np.asarray(steps[0].values)[:0])
+        return {"Out": [RaggedTensor(
+            jnp.asarray(out),
+            [np.cumsum([0] + outer_lengths).astype(np.int32),
+             np.cumsum([0] + inner_lengths).astype(np.int32)])]}
     out_rows, new_splits = [], [0]
     for i, n in table.items:
         out_rows.extend(seqs[i])

@@ -114,7 +114,8 @@ def minus(ctx, ins, attrs):
 def _reduce(name, fn, acc_f32=False):
     @register_op(name)
     def kernel(ctx, ins, attrs, fn=fn):
-        x = _vals(_x(ins))
+        xr = _x(ins)
+        x = _vals(xr)
         if acc_f32 and x.dtype == jnp.bfloat16:
             # sum-style reductions accumulate in f32 (bf16's 8 mantissa
             # bits saturate after a few hundred ~1.0 addends); max/min
@@ -131,6 +132,11 @@ def _reduce(name, fn, acc_f32=False):
         out = fn(x, axis=dim)
         if attrs.get("keep_dim", False):
             out = jnp.expand_dims(out, dim)
+        # reducing a feature axis of a ragged sequence keeps one row per
+        # step: still a sequence (keep_dim preserves the row axis)
+        if isinstance(xr, RaggedTensor) and dim != 0 \
+                and attrs.get("keep_dim", False):
+            return {"Out": [xr.with_values(out)]}
         return {"Out": [out]}
     kernel.__name__ = name
     return kernel
@@ -148,9 +154,21 @@ def mean(ctx, ins, attrs):
     # convention for scalars (mean_op.cc InferShape -> {1}); a bf16
     # input (FLAGS_amp_bf16_act) accumulates in f32 — this is almost
     # always the final loss reduction
-    x = _vals(_x(ins))
+    xr = _x(ins)
+    x = _vals(xr)
     if x.dtype == jnp.bfloat16:
         x = x.astype(jnp.float32)
+    from ..core.ragged import RaggedTensor
+
+    if isinstance(xr, RaggedTensor):
+        # a ragged loss means per-token rows padded to the bucket: the
+        # mean must cover VALID rows only, or every padded row's
+        # garbage (-log eps after a masked softmax) drowns the signal
+        rows = x.reshape(x.shape[0], -1)
+        mask = xr.valid_mask().astype(rows.dtype)
+        total = jnp.sum(rows * mask[:, None])
+        denom = xr.nvalid.astype(rows.dtype) * rows.shape[1]
+        return {"Out": [jnp.reshape(total / jnp.maximum(denom, 1), (1,))]}
     return {"Out": [jnp.reshape(jnp.mean(x), (1,))]}
 
 

@@ -78,7 +78,15 @@ def cast(ctx, ins, attrs):
 @register_op("concat")
 def concat(ctx, ins, attrs):
     axis = int(attrs.get("axis", 0))
-    return {"Out": [jnp.concatenate([_vals(v) for v in ins["X"]], axis)]}
+    xs = ins["X"]
+    # feature-axis concat of ragged sequences stays ragged: the rows
+    # line up step-for-step, so concat the values and keep row_splits
+    # (axis-0 ragged concat is the separate sequence_concat op)
+    ragged = next((v for v in xs if isinstance(v, RaggedTensor)), None)
+    out = jnp.concatenate([_vals(v) for v in xs], axis)
+    if ragged is not None and axis != 0:
+        return {"Out": [ragged.with_values(out)]}
+    return {"Out": [out]}
 
 
 @register_op("split")
@@ -87,11 +95,15 @@ def split(ctx, ins, attrs):
     axis = int(attrs.get("axis", 0))
     sections = attrs.get("sections")
     num = attrs.get("num", 0)
+    ragged = isinstance(x, RaggedTensor)
+    vals = x.values if ragged else x
     if sections:
         idx = np.cumsum(sections[:-1]).tolist()
-        parts = jnp.split(x, idx, axis)
+        parts = jnp.split(vals, idx, axis)
     else:
-        parts = jnp.split(x, int(num), axis)
+        parts = jnp.split(vals, int(num), axis)
+    if ragged and axis != 0:
+        parts = [x.with_values(p) for p in parts]
     return {"Out": list(parts)}
 
 
@@ -138,7 +150,11 @@ def sum_op(ctx, ins, attrs):
 
 @register_op("scale")
 def scale(ctx, ins, attrs):
-    return {"Out": [_x(ins) * attrs.get("scale", 1.0)]}
+    x = _x(ins)
+    s = attrs.get("scale", 1.0)
+    if isinstance(x, RaggedTensor):
+        return {"Out": [x.with_values(x.values * s)]}
+    return {"Out": [x * s]}
 
 
 @register_op("increment")

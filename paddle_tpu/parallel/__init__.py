@@ -23,6 +23,8 @@ from .ring import ring_attention, ulysses_attention, sp_shard_map
 from .pipeline import (gpipe_spmd, pipeline_apply, split_microbatches,
                        stack_stage_params)
 from .moe import switch_moe, moe_shard_map, init_moe_params
+from .program_api import (lower_program_fn, PipelineProgramTrainer,
+                          MoEProgramLayer)
 
 __all__ = [
     "make_mesh", "MeshConfig", "param_spec", "batch_spec", "shard_state",
@@ -30,5 +32,6 @@ __all__ = [
     "ring_attention", "ulysses_attention", "sp_shard_map",
     "gpipe_spmd", "pipeline_apply", "split_microbatches",
     "stack_stage_params", "switch_moe", "moe_shard_map",
-    "init_moe_params",
+    "init_moe_params", "lower_program_fn", "PipelineProgramTrainer",
+    "MoEProgramLayer",
 ]

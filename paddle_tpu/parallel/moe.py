@@ -45,18 +45,25 @@ def init_moe_params(key, d_model, d_hidden, n_experts, dtype=jnp.float32):
 
 
 def switch_moe(params, x, axis_name="ep", capacity_factor=1.25,
-               batch_axes=()):
+               batch_axes=(), expert_fn=None):
     """Per-device MoE layer; call inside shard_map.
 
-    params: gate_w [d, E] replicated; w1/b1/w2/b2 with the expert axis
-    "ep"-sharded (local leading dim E/ep).  x: [b, d] local tokens.
-    batch_axes: extra mesh axes the tokens shard over (e.g. ("dp",)) so
-    the aux statistics average over ALL token shards.  Returns
-    (y [b, d], aux) — aux is the Switch load-balancing loss
+    params: gate_w [d, E] replicated; expert weights with the expert
+    axis "ep"-sharded (local leading dim E/ep) — either the built-in
+    FFN's w1/b1/w2/b2, or, with `expert_fn`, an "experts" pytree of
+    arbitrary structure.  expert_fn(local_expert_params, xin) must map
+    [e_loc, tokens, d] -> [e_loc, tokens, d] (e.g. a vmapped
+    Program-lowered FFN).  x: [b, d] local tokens.  batch_axes: extra
+    mesh axes the tokens shard over (e.g. ("dp",)) so the aux
+    statistics average over ALL token shards.  Returns (y [b, d], aux)
+    — aux is the Switch load-balancing loss
     (E * sum(fraction_routed * mean_router_prob); ~1 when balanced).
     """
     ep = lax.psum(1, axis_name)
-    e_loc = params["w1"].shape[0]
+    if expert_fn is None:
+        e_loc = params["w1"].shape[0]
+    else:
+        e_loc = jax.tree_util.tree_leaves(params["experts"])[0].shape[0]
     n_expert = e_loc * ep
     b, d = x.shape
 
@@ -90,10 +97,13 @@ def switch_moe(params, x, axis_name="ep", capacity_factor=1.25,
                                                    ep * capacity, d)
 
     # --- expert FFN (vmapped over local experts; MXU batched) ---
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, params["w1"]) +
-                    params["b1"][:, None, :])
-    out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + \
-        params["b2"][:, None, :]                       # [e_loc, ep*C, d]
+    if expert_fn is None:
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, params["w1"]) +
+                        params["b1"][:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + \
+            params["b2"][:, None, :]                   # [e_loc, ep*C, d]
+    else:
+        out = expert_fn(params["experts"], xin)        # [e_loc, ep*C, d]
 
     # --- ship results back and combine ---
     out = out.reshape(e_loc, ep, capacity, d)
@@ -117,19 +127,36 @@ def switch_moe(params, x, axis_name="ep", capacity_factor=1.25,
 
 
 def moe_shard_map(mesh, axis_name="ep", batch_axis="dp",
-                  capacity_factor=1.25):
+                  capacity_factor=1.25, expert_fn=None,
+                  expert_param_template=None):
     """Wrap switch_moe for `mesh`: tokens shard over (dp, ep) jointly,
     expert weights shard over ep, the router replicates.
+
+    With `expert_fn`, params must be {"gate_w": ..., "experts": pytree}
+    where every experts leaf has a leading [E] axis (sharded over ep);
+    pass that pytree (or one with the same structure) as
+    expert_param_template so the shard_map specs can be derived.
 
     Returns fn(params, x[B, d]) -> (y[B, d], aux)."""
     axes = tuple(a for a in (batch_axis, axis_name) if a in mesh.shape)
     x_spec = P(axes if len(axes) > 1 else (axes[0] if axes else None))
-    param_specs = {
-        "gate_w": P(), "w1": P(axis_name), "b1": P(axis_name),
-        "w2": P(axis_name), "b2": P(axis_name),
-    }
+    if expert_fn is None:
+        param_specs = {
+            "gate_w": P(), "w1": P(axis_name), "b1": P(axis_name),
+            "w2": P(axis_name), "b2": P(axis_name),
+        }
+    else:
+        if expert_param_template is None:
+            raise ValueError(
+                "expert_fn needs expert_param_template to derive specs")
+        param_specs = {
+            "gate_w": P(),
+            "experts": jax.tree_util.tree_map(
+                lambda _: P(axis_name), expert_param_template),
+        }
     fn = functools.partial(
         switch_moe, axis_name=axis_name, capacity_factor=capacity_factor,
-        batch_axes=tuple(a for a in axes if a != axis_name))
+        batch_axes=tuple(a for a in axes if a != axis_name),
+        expert_fn=expert_fn)
     return shard_map_norep(fn, mesh=mesh, in_specs=(param_specs, x_spec),
                            out_specs=(x_spec, P()))

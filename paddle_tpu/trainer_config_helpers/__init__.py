@@ -170,6 +170,17 @@ sequence_conv_pool = _networks.sequence_conv_pool
 simple_lstm = _networks.simple_lstm
 bidirectional_lstm = _networks.bidirectional_lstm
 simple_gru = _networks.simple_gru
+simple_gru2 = _networks.simple_gru2
+lstmemory_unit = _networks.lstmemory_unit
+lstmemory_group = _networks.lstmemory_group
+gru_unit = _networks.gru_unit
+gru_group = _networks.gru_group
+bidirectional_gru = _networks.bidirectional_gru
+simple_attention = _networks.simple_attention
+dot_product_attention = _networks.dot_product_attention
+multi_head_attention = _networks.multi_head_attention
+small_vgg = _networks.small_vgg
+vgg_16_network = _networks.vgg_16_network
 
 __all__ = [n for n in dir() if not n.startswith("_")]
 

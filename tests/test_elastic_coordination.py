@@ -1,0 +1,164 @@
+"""Elastic pserver coordination over the native master's TTL-lease
+registry (reference: go/pserver/etcd_client.go:31-97 — slot
+registration with TTL keep-alive, desired-count rendezvous, trainer
+re-discovery; go/pserver/service.go checkpoint/restore)."""
+
+import time
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import native
+from paddle_tpu.distributed import (DistributeTranspiler,
+                                    ElasticRegistry)
+from paddle_tpu.ops.dist import ClientPool
+
+TTL_MS = 300
+
+
+def test_lease_register_expire_rediscover():
+    master = native.Master()
+    try:
+        reg = ElasticRegistry("127.0.0.1", master.port)
+        # two pservers claim the two slots; a third finds none free
+        slot_a, lease_a = reg.register_pserver("h1:1", 2, ttl_ms=TTL_MS)
+        slot_b, lease_b = reg.register_pserver("h2:2", 2, ttl_ms=TTL_MS)
+        assert {slot_a, slot_b} == {0, 1}
+        try:
+            reg.register_pserver("h3:3", 2, ttl_ms=TTL_MS, timeout=0.3)
+            raise AssertionError("third pserver should find no slot")
+        except TimeoutError:
+            pass
+
+        # rendezvous sees both, ordered by slot
+        assert reg.wait_for_pservers(2, timeout=5) == ["h1:1", "h2:2"]
+
+        # keep-alive holds the lease well past one TTL
+        time.sleep(TTL_MS / 1000.0 * 3)
+        assert len(reg.pservers()) == 2
+        assert not lease_a.lapsed
+
+        # kill pserver A (stop heartbeating): its lease lapses and
+        # discovery stops returning it
+        lease_a._stop.set()
+        lease_a._thread.join(timeout=5)
+        deadline = time.time() + 5
+        while len(reg.pservers()) != 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert reg.pservers() == {slot_b: "h2:2"}
+
+        # a replacement claims the freed slot; rendezvous recovers
+        slot_c, lease_c = reg.register_pserver("h4:4", 2, ttl_ms=TTL_MS)
+        assert slot_c == slot_a
+        assert sorted(reg.pservers().values()) == ["h2:2", "h4:4"]
+        lease_b.release()
+        lease_c.release()
+        reg.close()
+    finally:
+        master.stop()
+
+
+def test_kill_pserver_and_recover_training():
+    """End-to-end elasticity: trainer discovers pservers through the
+    registry, one pserver dies mid-training, a replacement restores
+    its shard from checkpoint and re-registers, the trainer
+    re-discovers and training continues converging."""
+    import tempfile
+    import os
+
+    master = native.Master()
+    servers = [native.ParameterServer(num_trainers=1, sync=True)
+               for _ in range(2)]
+    reg = ElasticRegistry("127.0.0.1", master.port)
+    leases = {}
+    try:
+        for s in servers:
+            slot, lease = reg.register_pserver(
+                "127.0.0.1:%d" % s.port, 2, ttl_ms=TTL_MS)
+            leases[slot] = lease
+
+        # trainer side: rendezvous for the endpoints, then transpile
+        endpoints = reg.wait_for_pservers(2, timeout=10)
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        cost = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        optimize_ops, params_grads = fluid.optimizer.SGD(
+            learning_rate=0.1).minimize(cost)
+        t = DistributeTranspiler()
+        t.transpile(optimize_ops=optimize_ops, params_grads=params_grads,
+                    pservers=",".join(endpoints), trainers=1,
+                    split_method=lambda vs, n:
+                        __import__("paddle_tpu.distributed",
+                                   fromlist=["split_dense_variable"])
+                        .split_dense_variable(vs, n, min_block_size=2))
+
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        exe.run(fluid.default_startup_program())
+        t.init_pservers()
+
+        rs = np.random.RandomState(0)
+        xs = rs.rand(32, 4).astype(np.float32)
+        ys = (xs @ np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+              ).reshape(-1, 1)
+        feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+        feed = feeder.feed([(xs[i], ys[i]) for i in range(32)])
+
+        losses = []
+        for _ in range(10):
+            out, = exe.run(fluid.default_main_program(), feed=feed,
+                           fetch_list=[cost])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+
+        # ---- pserver 0 dies; its shard recovers from checkpoint ----
+        ckpt = os.path.join(tempfile.mkdtemp(), "ps0.ckpt")
+        assert servers[0].save(ckpt) == 0
+        dead_port = servers[0].port
+        leases[0]._stop.set()            # heartbeat stops with it
+        servers[0].stop()
+        ClientPool.reset()               # trainer drops dead sockets
+        deadline = time.time() + 5
+        while len(reg.pservers()) != 1 and time.time() < deadline:
+            time.sleep(0.05)
+
+        replacement = native.ParameterServer(num_trainers=1, sync=True)
+        assert replacement.load(ckpt) == 0
+        slot, lease = reg.register_pserver(
+            "127.0.0.1:%d" % replacement.port, 2, ttl_ms=TTL_MS)
+        assert slot == 0
+        leases[0] = lease
+        servers[0] = replacement
+
+        # trainer re-discovers and repoints the dead endpoint's blocks
+        new_endpoints = reg.wait_for_pservers(2, timeout=10)
+        assert "127.0.0.1:%d" % dead_port not in new_endpoints
+        remap = {"127.0.0.1:%d" % dead_port:
+                 "127.0.0.1:%d" % replacement.port}
+        for pname, blocks in t.param_blocks.items():
+            t.param_blocks[pname] = [
+                (remap.get(ep, ep), b, s) for ep, b, s in blocks]
+        for op in fluid.default_main_program().global_block().ops:
+            if op.type == "dist_send":
+                op.desc.attrs["blocks"] = [
+                    (remap.get(ep, ep), b, s)
+                    for ep, b, s in op.desc.attrs["blocks"]]
+
+        for _ in range(10):
+            out, = exe.run(fluid.default_main_program(), feed=feed,
+                           fetch_list=[cost])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+        # training continued from the checkpointed state: no blow-up,
+        # further convergence
+        assert losses[-1] < losses[9], (losses[9], losses[-1])
+        assert losses[-1] < losses[0]
+        assert replacement.num_updates() > 0
+    finally:
+        ClientPool.reset()
+        for lease in leases.values():
+            lease._stop.set()
+        for s in servers:
+            s.stop()
+        reg.close()
+        master.stop()

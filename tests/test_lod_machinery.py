@@ -45,6 +45,100 @@ def test_rank_table_array_roundtrip():
     assert rvals.tolist() == expect
 
 
+def test_nested_rank_table_array_roundtrip():
+    """lod_level-2 input through the rank-table machinery (reference:
+    the nested-sequence mode of RecurrentGradientMachine.h:32 on
+    lod_rank_table/lod_tensor_to_array): ranking at level 0 orders
+    outer sequences by subsequence count, each array step is a lod-1
+    batch of the t-th subsequences, and the roundtrip reassembles the
+    nested tensor in rank order."""
+    from paddle_tpu.core.ragged import RaggedTensor
+    from paddle_tpu.ops.registry import get_op_info
+
+    # doc A: 1 sentence [1,2]; doc B: 2 sentences [3],[4,5,6]
+    vals = np.arange(1, 7, dtype=np.float32).reshape(6, 1)
+    x = RaggedTensor(vals,
+                     [np.array([0, 1, 3], np.int32),        # outer
+                      np.array([0, 2, 3, 6], np.int32)])    # inner
+    rank = get_op_info("lod_rank_table").kernel
+    to_arr = get_op_info("lod_tensor_to_array").kernel
+    to_lod = get_op_info("array_to_lod_tensor").kernel
+    reorder = get_op_info("reorder_lod_tensor_by_rank").kernel
+
+    table = rank(None, {"X": [x]}, {"level": 0})["Out"][0]
+    # doc B (2 sentences) ranks first
+    assert table.indices() == [1, 0]
+    assert table.lengths() == [2, 1]
+
+    steps = to_arr(None, {"X": [x], "RankTable": [table]}, {})["Out"][0]
+    assert len(steps) == 2
+    # step 0: first sentences of B then A -> [3] and [1,2]
+    s0 = steps[0]
+    assert np.asarray(s0.values).reshape(-1).tolist() == [3, 1, 2]
+    assert np.asarray(s0.row_splits[-1]).tolist() == [0, 1, 3]
+    # step 1: only B is still active -> [4,5,6]
+    s1 = steps[1]
+    assert np.asarray(s1.values).reshape(-1).tolist() == [4, 5, 6]
+
+    back = to_lod(None, {"X": [steps], "RankTable": [table]},
+                  {})["Out"][0]
+    assert back.lod_level == 2
+    assert np.asarray(back.values).reshape(-1).tolist() == \
+        [3, 4, 5, 6, 1, 2]
+    assert np.asarray(back.row_splits[0]).tolist() == [0, 2, 3]
+    assert np.asarray(back.row_splits[1]).tolist() == [0, 1, 4, 6]
+
+    reord = reorder(None, {"X": [x], "RankTable": [table]}, {})["Out"][0]
+    assert np.asarray(reord.values).reshape(-1).tolist() == \
+        [3, 4, 5, 6, 1, 2]
+    assert np.asarray(reord.row_splits[0]).tolist() == [0, 2, 3]
+    assert np.asarray(reord.row_splits[1]).tolist() == [0, 1, 4, 6]
+
+
+def test_nested_dynamic_rnn():
+    """Nested-sequence DynamicRNN, compiled form: sequence_unnest
+    flattens subsequences into the batch, an inner DynamicRNN recurs
+    over tokens within each subsequence, sequence_renest lifts the
+    per-subsequence encodings to a sentence-level sequence, and an
+    outer DynamicRNN recurs across subsequences — the full nested
+    recurrence of the reference's RecurrentGradientMachine, with both
+    loops as masked scans."""
+    x = layers.data(name="x", shape=[2], dtype="float32", lod_level=2)
+
+    inner, outer_ref = layers.sequence_unnest(x)
+    drnn_in = layers.DynamicRNN()
+    with drnn_in.block():
+        tok = drnn_in.step_input(inner)
+        mem = drnn_in.memory(shape=[2], batch_ref=tok, value=0.0)
+        acc = layers.elementwise_add(x=mem, y=tok)
+        drnn_in.update_memory(mem, acc)
+        drnn_in.output(acc)
+    token_sums = layers.sequence_last_step(drnn_in())  # per subsequence
+    sent_seq = layers.sequence_renest(token_sums, outer_ref)
+
+    drnn_out = layers.DynamicRNN()
+    with drnn_out.block():
+        sent = drnn_out.step_input(sent_seq)
+        mem = drnn_out.memory(shape=[2], batch_ref=sent, value=0.0)
+        acc = layers.elementwise_add(x=mem, y=sent)
+        drnn_out.update_memory(mem, acc)
+        drnn_out.output(acc)
+    doc_enc = layers.sequence_last_step(drnn_out())  # [docs, 2]
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    # doc A: sentences [[1,1],[2,2]] and [[3,3]]; doc B: [[10,10]]
+    docs = [[[[1, 1], [2, 2]], [[3, 3]]],
+            [[[10, 10]]]]
+    feeder = fluid.DataFeeder(place=place, feed_list=[x])
+    feed = feeder.feed([(d,) for d in docs])
+    out, = exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=[doc_enc])
+    # doc A: sent sums (3,3) and (3,3) -> outer sum (6,6); doc B: (10,10)
+    np.testing.assert_allclose(np.asarray(out),
+                               [[6, 6], [10, 10]])
+
+
 def test_shrink_memory():
     from paddle_tpu.core.rank_table import LoDRankTable
     from paddle_tpu.ops.registry import get_op_info

@@ -116,3 +116,41 @@ def test_moe_aux_identical_across_meshes():
     _, aux_dp = moe_shard_map(_mesh((2, 4), ("dp", "ep")),
                               capacity_factor=float(E))(params, x)
     np.testing.assert_allclose(float(aux_ep), float(aux_dp), rtol=1e-6)
+
+
+def test_moe_program_expert():
+    """The expert network as a fluid-built Program (vmapped over the
+    local expert axis): dispatch/combine trains and the output depends
+    on the Program experts' weights."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel import MoEProgramLayer
+
+    def build_expert():
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            h = fluid.layers.data(name="h", shape=[D], dtype="float32")
+            out = fluid.layers.fc(input=h, size=D, act="tanh")
+        return main, startup, "h", out.name
+
+    mesh = _mesh((2, 4), ("dp", "ep"))
+    layer = MoEProgramLayer(build_expert, n_experts=E, d_model=D,
+                            mesh=mesh, capacity_factor=float(E))
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(64, D).astype(np.float32))
+
+    def loss_fn(params):
+        y, aux = layer(params, x)
+        return jnp.mean((y - x) ** 2) + 0.01 * aux
+
+    params = layer.params
+    step = jax.jit(lambda p: (loss_fn(p), jax.grad(loss_fn)(p)))
+    losses = []
+    for _ in range(10):
+        loss, grads = step(params)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.2 * g,
+                                        params, grads)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    g = np.asarray(grads["experts"]["fc_0.w_0"])
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
