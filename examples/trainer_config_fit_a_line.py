@@ -1,0 +1,25 @@
+"""paddle_trainer-style config: linear regression on uci_housing
+(reference: the `paddle train --config=...` flow of
+TrainerMain.cpp + trainer_config_helpers configs).
+
+    python -m paddle_tpu.tools.trainer_cli \
+        --config=examples/trainer_config_fit_a_line.py --num_passes=5
+"""
+
+from paddle_tpu.trainer_config_helpers import *  # noqa: F401,F403
+
+settings(batch_size=20, learning_rate=0.01,
+         learning_method=MomentumOptimizer(momentum=0.9))  # noqa: F405
+
+define_py_data_sources2(                                   # noqa: F405
+    train_list="train", test_list="test",
+    module="paddle_tpu.dataset.uci_housing_provider",
+    obj="provide")
+
+x = data_layer(name="x", size=13)                          # noqa: F405
+y_predict = fc_layer(input=x, size=1,                      # noqa: F405
+                     act=LinearActivation())               # noqa: F405
+y = data_layer(name="y", size=1)                           # noqa: F405
+cost = mse_cost(input=y_predict, label=y)                  # noqa: F405
+
+outputs(cost)                                              # noqa: F405

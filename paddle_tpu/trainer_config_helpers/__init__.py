@@ -11,9 +11,21 @@ from ..v2 import activation as _act
 from ..v2 import attr as _attr
 from ..v2 import layer as _layer
 from ..v2 import networks as _networks
+from ..v2 import optimizer as _optimizer
 from ..v2 import pooling as _pooling
 from ..v2.data_type import (dense_vector, integer_value,  # noqa: F401
                             integer_value_sequence, dense_vector_sequence)
+from .config import (settings, outputs,  # noqa: F401
+                     define_py_data_sources2)
+
+# optimizers (reference: trainer_config_helpers/optimizers.py)
+MomentumOptimizer = _optimizer.Momentum
+AdamOptimizer = _optimizer.Adam
+AdamaxOptimizer = _optimizer.Adamax
+AdaGradOptimizer = _optimizer.AdaGrad
+DecayedAdaGradOptimizer = _optimizer.DecayedAdaGrad
+AdaDeltaOptimizer = _optimizer.AdaDelta
+RMSPropOptimizer = _optimizer.RMSProp
 
 # activations (reference: trainer_config_helpers/activations.py)
 TanhActivation = _act.Tanh
@@ -43,7 +55,32 @@ ExtraAttr = _attr.Extra
 ExtraLayerAttribute = _attr.Extra
 
 # layers (reference: trainer_config_helpers/layers.py *_layer funcs)
-data_layer = _layer.data
+def data_layer(name, size=None, type=None, height=None, width=None,
+               depth=None, **kw):
+    """reference: layers.py data_layer(name, size[, depth, height,
+    width]) — the DSL spelling takes a flat size (+ optional
+    volumetric/image dims); the v2 spelling takes an InputType.  Both
+    accepted here."""
+    if type is None:
+        if size is None:
+            raise ValueError("data_layer needs size= or type=")
+        if height and width:
+            spatial = (depth or 1) * height * width
+            if size % spatial:
+                raise ValueError(
+                    "data_layer size %d is not divisible by the "
+                    "%s dims %s" % (size,
+                                    "depth*height*width" if depth
+                                    else "height*width", spatial))
+            channels = size // spatial
+            from ..v2.data_type import dense_array
+
+            shape = ([channels, depth, height, width] if depth
+                     else [channels, height, width])
+            type = dense_array(size, shape)
+        else:
+            type = dense_vector(size)
+    return _layer.data(name=name, type=type, **kw)
 fc_layer = _layer.fc
 embedding_layer = _layer.embedding
 img_conv_layer = _layer.img_conv
