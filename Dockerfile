@@ -1,0 +1,28 @@
+# paddle_tpu runtime image (reference: the reference's Docker build
+# pipeline, paddle/scripts/docker/build.sh — there it compiles the
+# whole C++ tree; here the image is a Python env + host toolchain, and
+# the small native runtime compiles at first import).
+#
+#   docker build -t paddle-tpu .
+#   docker run --rm paddle-tpu python -m pytest tests/ -q
+#
+# For real TPUs use a TPU-VM base image that ships libtpu and install
+# jax[tpu] instead of jax[cpu].
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /workspace/paddle_tpu
+COPY . .
+
+RUN pip install --no-cache-dir "jax[cpu]" numpy pytest && \
+    pip install --no-cache-dir .
+
+# build the native runtime now so first use in containers is instant
+RUN make -C native
+
+ENV JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+CMD ["python", "-m", "pytest", "tests/", "-q"]

@@ -1,0 +1,36 @@
+"""Python side of the C inference API (consumed by native/capi.cc).
+
+reference: paddle/capi — the C deployment path loads a trained model
+and runs forward-only; here CEngine wraps load_inference_model and a
+cached compiled executor run.
+"""
+
+import numpy as np
+
+__all__ = ["CEngine"]
+
+
+class CEngine:
+    def __init__(self, model_dir):
+        import paddle_tpu.fluid as fluid
+
+        self._fluid = fluid
+        self._exe = fluid.Executor(fluid.CPUPlace())
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            model_dir, self._exe)
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch_vars = fetch_vars
+
+    def run(self, arr):
+        outs = self._exe.run(self._program,
+                             feed={self._feed_names[0]: arr},
+                             fetch_list=list(self._fetch_vars))
+        return np.asarray(outs[0])
+
+    def run_raw(self, data, shape):
+        """bytes + shape tuple -> (bytes, shape tuple); float32 only
+        (the C API's plain-buffer contract)."""
+        arr = np.frombuffer(data, np.float32).reshape(shape)
+        out = self.run(arr).astype(np.float32)
+        return out.tobytes(), tuple(int(d) for d in out.shape)

@@ -1,0 +1,32 @@
+"""Datasets with the reference's reader API.
+
+reference: python/paddle/v2/dataset/ (mnist, cifar, imdb, uci_housing,
+imikolov, movielens, conll05, sentiment, wmt14/16...).
+
+mnist (idx), cifar (pickled-batch tar), imdb (aclImdb tar) and conll05
+(column files) carry REAL parsers: they download into
+`~/.cache/paddle_tpu/dataset/` when the network allows (md5-checked,
+common.py) and accept explicit file paths.  When neither is available
+(this build is zero-egress) every dataset falls back to a
+*deterministic synthetic stand-in* with the exact shapes, dtypes and
+reader API of the original — enough for training-loop,
+convergence-trend and benchmark tests.  Network fetches are opt-in:
+set PADDLE_TPU_ALLOW_DOWNLOAD=1 to download."""
+
+from . import uci_housing  # noqa: F401
+from . import mnist        # noqa: F401
+from . import cifar        # noqa: F401
+from . import imdb         # noqa: F401
+from . import imikolov     # noqa: F401
+from . import movielens    # noqa: F401
+from . import conll05      # noqa: F401
+from . import wmt14        # noqa: F401
+from . import wmt16        # noqa: F401
+from . import sentiment    # noqa: F401
+from . import mq2007       # noqa: F401
+from . import flowers      # noqa: F401
+from . import voc2012      # noqa: F401
+
+__all__ = ["uci_housing", "mnist", "cifar", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14", "wmt16", "sentiment",
+           "mq2007", "flowers", "voc2012"]

@@ -1,0 +1,37 @@
+"""WMT14 translation stand-in (reference: python/paddle/v2/dataset/
+wmt14.py — (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk>)."""
+
+from .common import rng
+
+__all__ = ["train", "test", "ID_MARK_START", "ID_MARK_END", "ID_MARK_UNK"]
+
+ID_MARK_START = 0
+ID_MARK_END = 1
+ID_MARK_UNK = 2
+
+_DICT = 30000
+
+
+def _reader(n, dict_size, seed):
+    r = rng(seed)
+
+    def reader():
+        for _ in range(n):
+            src_len = int(r.randint(3, 20))
+            src = r.randint(3, dict_size, size=src_len).tolist()
+            # target = reversed source with offset: a learnable mapping
+            trg = [(t + 17) % dict_size for t in reversed(src)]
+            trg = [max(3, t) for t in trg]
+            trg_in = [ID_MARK_START] + trg
+            trg_next = trg + [ID_MARK_END]
+            yield src, trg_in, trg_next
+
+    return reader
+
+
+def train(dict_size=_DICT):
+    return _reader(1024, dict_size, 55)
+
+
+def test(dict_size=_DICT):
+    return _reader(128, dict_size, 56)

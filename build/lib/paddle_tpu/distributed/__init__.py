@@ -1,0 +1,23 @@
+"""Distributed training (DCN/pserver path).
+
+Two complementary mechanisms, matching the reference's split:
+
+  * In-mesh data/model parallelism over ICI — `paddle_tpu.parallel`
+    (pjit/shard_map; replaces NCCL ops and MultiGradientMachine's ring).
+  * Parameter-server distribution over hosts — this package: a graph
+    transpiler that rewrites the trainer program to ship gradients to
+    native C++ pservers that run the optimizer server-side
+    (reference: python/paddle/v2/fluid/distribute_transpiler.py:81,
+    operators/send_op.cc, recv_op.cc, paddle/pserver/ParameterServer2,
+    go/pserver/service.go).
+"""
+
+from .transpiler import (DistributeTranspiler, split_dense_variable,
+                         run_pserver)
+
+from .coordinator import (init_multihost, global_mesh, process_count,
+                          process_index, ElasticRegistry, ServiceLease)
+
+__all__ = ["DistributeTranspiler", "split_dense_variable", "run_pserver",
+           "init_multihost", "global_mesh", "process_count",
+           "process_index", "ElasticRegistry", "ServiceLease"]

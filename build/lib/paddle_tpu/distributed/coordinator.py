@@ -1,0 +1,207 @@
+"""Multi-host coordination: device-mesh init + elastic service registry.
+
+Two coordination layers, mirroring the reference's split:
+
+* Dense collective path — on TPU pods the runtime itself provides
+  rendezvous: every host calls `jax.distributed.initialize` against one
+  coordinator address and the PJRT client wires ICI/DCN
+  (init_multihost / global_mesh below).
+
+* Pserver path — the reference coordinates pservers through etcd:
+  TTL-lease slot registration with keep-alive, desired-count
+  rendezvous, and trainer-side re-discovery (reference:
+  go/pserver/etcd_client.go:31-97 registration, client/etcd_client.go
+  discovery, go/master/etcd_client.go leader lock).  Here the native
+  master service carries an equivalent TTL-lease registry
+  (native/master.cc kRegister/kKeepAlive/kList) and ElasticRegistry /
+  ServiceLease below are the client surface: a pserver registers its
+  endpoint under /ps/<slot> and heartbeats; when it dies, the lease
+  lapses, discovery stops returning it, and a replacement can claim
+  the slot and restore from checkpoint.
+
+Env protocol (set by tools/cluster_launch.py or any scheduler):
+    PADDLE_COORDINATOR   host:port of process 0
+    PADDLE_NUM_PROCESSES world size
+    PADDLE_PROCESS_ID    this host's rank
+"""
+
+import os
+import threading
+import time
+
+__all__ = ["init_multihost", "global_mesh", "process_count",
+           "process_index", "ElasticRegistry", "ServiceLease"]
+
+_initialized = [False]
+
+
+def init_multihost(coordinator=None, num_processes=None, process_id=None,
+                   local_device_ids=None):
+    """Bring up the multi-host JAX runtime.  No-ops on single-host
+    (nothing set and no args) so user scripts can call it
+    unconditionally."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("PADDLE_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_NUM_PROCESSES", "0")) \
+            or None
+    if process_id is None:
+        pid = os.environ.get("PADDLE_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+
+    if coordinator is None and num_processes in (None, 1):
+        return False  # single host; jax is already usable
+    if _initialized[0]:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized[0] = True
+    return True
+
+
+def process_count():
+    import jax
+
+    return jax.process_count()
+
+
+def process_index():
+    import jax
+
+    return jax.process_index()
+
+
+class ServiceLease:
+    """A held registration: renews its TTL lease on a daemon thread
+    until released (the reference pserver's etcd keep-alive loop,
+    go/pserver/etcd_client.go).  `lapsed` flips if a renewal finds the
+    lease expired (e.g. the process stalled past the TTL) — the holder
+    must re-register.
+
+    `client` must be a connection DEDICATED to this lease: the
+    heartbeat runs on its own thread and the framed transport is not
+    thread-safe."""
+
+    def __init__(self, client, lease_id, ttl_ms):
+        self._client = client
+        self._lease = lease_id
+        self._ttl_ms = ttl_ms
+        self.lapsed = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        # renew at 1/3 TTL so one missed beat doesn't drop the slot
+        interval = max(0.01, self._ttl_ms / 3000.0)
+        while not self._stop.wait(interval):
+            try:
+                if not self._client.keep_alive(self._lease):
+                    self.lapsed = True
+                    return
+            except ConnectionError:
+                self.lapsed = True
+                return
+
+    def release(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # heartbeat wedged inside a blocking call: the transport is
+            # not thread-safe, so leak the connection rather than race
+            # an in-flight keep_alive; the TTL reclaims the slot
+            return
+        try:
+            self._client.unregister(self._lease)
+        except ConnectionError:
+            pass
+        self._client.close()
+
+
+class ElasticRegistry:
+    """Service registration/discovery over the native master's
+    TTL-lease store — the etcd-equivalent for pserver elasticity."""
+
+    PS_PREFIX = "/ps/"
+
+    def __init__(self, host, port):
+        from .. import native
+
+        self._host, self._port = host, port
+        self._client = native.MasterClient(host, port)
+
+    # -- registration ---------------------------------------------------
+    def register(self, key, value, ttl_ms=2000):
+        """Claim `key`; returns a ServiceLease, or None if a live lease
+        holds the key.  The lease heartbeats over its own dedicated
+        connection (the framed transport is not thread-safe)."""
+        from .. import native
+
+        client = native.MasterClient(self._host, self._port)
+        lease = client.register(key, value, ttl_ms)
+        if lease is None:
+            client.close()
+            return None
+        return ServiceLease(client, lease, ttl_ms)
+
+    def register_pserver(self, endpoint, desired_count, ttl_ms=2000,
+                         timeout=30.0):
+        """Claim the first free pserver slot /ps/0../ps/N-1 (the
+        reference's index-slot CAS loop, etcd_client.go:57-83),
+        retrying until a slot frees up or `timeout` lapses.
+        Returns (slot, ServiceLease)."""
+        deadline = time.time() + timeout
+        while True:
+            for slot in range(desired_count):
+                lease = self.register("%s%d" % (self.PS_PREFIX, slot),
+                                      endpoint, ttl_ms=ttl_ms)
+                if lease is not None:
+                    return slot, lease
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    "no free pserver slot of %d within %.1fs"
+                    % (desired_count, timeout))
+            time.sleep(min(0.05, ttl_ms / 1000.0))
+
+    # -- discovery ------------------------------------------------------
+    def pservers(self):
+        """{slot: endpoint} of live pservers."""
+        entries = self._client.list_prefix(self.PS_PREFIX)
+        return {int(k[len(self.PS_PREFIX):]): v
+                for k, v in entries.items()}
+
+    def wait_for_pservers(self, count, timeout=60.0):
+        """Desired-count rendezvous: block until `count` live pservers
+        are registered (reference: etcd_client.go desired-count wait);
+        returns endpoints ordered by slot."""
+        deadline = time.time() + timeout
+        while True:
+            live = self.pservers()
+            if len(live) >= count:
+                return [live[s] for s in sorted(live)]
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    "only %d of %d pservers registered within %.1fs"
+                    % (len(live), count, timeout))
+            time.sleep(0.05)
+
+    def close(self):
+        self._client.close()
+
+
+def global_mesh(dp=None, mp=1, sp=1, pp=1, ep=1, devices=None):
+    """Build a Mesh over ALL hosts' devices (jax.devices() is global
+    after init_multihost).  Delegates to parallel.make_mesh with
+    drop_unit_axes=True: only the axes actually >1 appear (plus "dp"),
+    in (dp, mp, sp, pp, ep) order."""
+    import jax
+    from ..parallel.mesh import make_mesh
+
+    devices = devices if devices is not None else jax.devices()
+    return make_mesh(n_devices=len(devices), dp=dp, mp=mp, sp=sp, pp=pp,
+                     ep=ep, axes=("dp", "mp", "sp", "pp", "ep"),
+                     devices=devices, drop_unit_axes=True)

@@ -1,0 +1,44 @@
+"""paddle_tpu.fluid — the program-based API, TPU-native.
+
+Mirrors the reference entry point python/paddle/v2/fluid/__init__.py: the
+same user-facing surface (Program builders, layers, optimizer, Executor,
+DataFeeder, io, initializer, regularizer, clip, profiler, nets), with an
+executor that compiles whole blocks via XLA instead of interpreting ops.
+"""
+
+from . import framework
+from .framework import (Program, Variable, Parameter, Operator, Block,
+                        default_main_program, default_startup_program,
+                        program_guard, switch_main_program,
+                        switch_startup_program, unique_name)
+from .executor import (Executor, Place, CPUPlace, TPUPlace, CUDAPlace,
+                       global_scope, scope_guard, fetch_var)
+from .backward import append_backward, calc_gradient
+from . import layers
+from . import nets
+from . import optimizer
+from . import initializer
+from . import regularizer
+from . import clip
+from . import io
+from . import checkpoint
+from . import evaluator
+from . import amp
+from . import memory_optimization_transpiler
+from .memory_optimization_transpiler import memory_optimize
+from . import profiler
+from .data_feeder import DataFeeder
+from .param_attr import ParamAttr
+from ..core.scope import Scope
+from ..core.ragged import RaggedTensor, SelectedRows
+from ..core import ragged as core  # minimal `core`-ish namespace
+
+__all__ = [
+    "framework", "layers", "optimizer", "initializer", "regularizer",
+    "clip", "io", "nets", "evaluator", "profiler",
+    "Program", "Variable", "Parameter", "Operator", "Block",
+    "default_main_program", "default_startup_program", "program_guard",
+    "Executor", "CPUPlace", "TPUPlace", "CUDAPlace", "global_scope",
+    "scope_guard", "DataFeeder", "ParamAttr", "Scope", "RaggedTensor",
+    "SelectedRows", "append_backward",
+]

@@ -1,0 +1,46 @@
+"""Automatic mixed precision: bf16 compute, f32 master weights.
+
+TPU-native counterpart of the reference's float16 support (reference:
+paddle/math/float16.h — CUDA half/ARM fp16 interop; fp16 design docs).
+On TPU the native fast dtype is bfloat16: when enabled, the heavy MXU
+ops (mul/matmul/conv/lstm projections) cast their f32 operands to bf16
+and accumulate in f32 (`preferred_element_type`) — master-weight
+semantics without loss scaling (bf16 keeps f32's exponent range).
+
+Activations BETWEEN ops also stay bf16 by default
+(`FLAGS_amp_bf16_act`): conv/matmul results are not cast back to f32,
+so the elementwise/norm chains read and write half the bytes (HBM
+bandwidth is the usual TPU bottleneck).  What remains f32 regardless:
+parameters + optimizer state (masters), all reduction statistics
+(batch/layer norm mean/var), losses, and everything crossing the
+feed/fetch boundary.  Set FLAGS_amp_bf16_act=0 for the conservative
+cast-back-to-f32 behaviour.
+"""
+
+import contextlib
+
+from ..utils import flags
+
+__all__ = ["enable_bf16", "disable_bf16", "bf16_enabled", "bf16_guard"]
+
+
+def enable_bf16():
+    flags.set_flag("amp_bf16", True)
+
+
+def disable_bf16():
+    flags.set_flag("amp_bf16", False)
+
+
+def bf16_enabled():
+    return flags.get_flag("amp_bf16")
+
+
+@contextlib.contextmanager
+def bf16_guard():
+    prev = bf16_enabled()
+    flags.set_flag("amp_bf16", True)
+    try:
+        yield
+    finally:
+        flags.set_flag("amp_bf16", prev)

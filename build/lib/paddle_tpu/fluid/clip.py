@@ -1,0 +1,168 @@
+"""Gradient and error clipping (reference: python/paddle/v2/fluid/clip.py)."""
+
+from . import framework
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "ErrorClipByValue",
+           "append_gradient_clip_ops", "error_clip_callback"]
+
+
+class BaseErrorClipAttr:
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(
+            type="clip", inputs={"X": [grad_name]},
+            outputs={"Out": [grad_name]},
+            attrs={"min": self.min, "max": self.max})
+
+
+class BaseGradientClipAttr:
+    def process_context(self, context, param, grad):
+        pass
+
+    def create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def create_operators(self, param, grad):
+        block = grad.block
+        out = block.create_var(
+            name=framework.unique_name(grad.name + "_clip"),
+            dtype=grad.dtype, shape=grad.shape)
+        block.append_op(type="clip", inputs={"X": [grad]},
+                        outputs={"Out": [out]},
+                        attrs={"min": self.min, "max": self.max})
+        return param, out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def create_operators(self, param, grad):
+        block = grad.block
+        out = block.create_var(
+            name=framework.unique_name(grad.name + "_clip"),
+            dtype=grad.dtype, shape=grad.shape)
+        block.append_op(type="clip_by_norm", inputs={"X": [grad]},
+                        outputs={"Out": [out]},
+                        attrs={"max_norm": self.clip_norm})
+        return param, out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Clip by the global norm over all grads in the group
+    (reference: clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+        block = grad.block
+        sq = block.create_var(
+            name=framework.unique_name(grad.name + "_sq"),
+            dtype=grad.dtype, shape=(1,))
+        block.append_op(type="squared_l2_norm", inputs={"X": [grad]},
+                        outputs={"Out": [sq]})
+        context[self.group_name].append(sq)
+        self.context = context
+
+    def create_operators(self, param, grad):
+        block = grad.block
+        group = self.context[self.group_name]
+        if not isinstance(group[-1], tuple):
+            # first call after process_context phase: build the global scale
+            gsum = block.create_var(
+                name=framework.unique_name("global_norm_sq"),
+                dtype=grad.dtype, shape=(1,))
+            block.append_op(type="sum", inputs={"X": group},
+                            outputs={"Out": [gsum]})
+            gnorm = block.create_var(
+                name=framework.unique_name("global_norm"),
+                dtype=grad.dtype, shape=(1,))
+            block.append_op(type="sqrt", inputs={"X": [gsum]},
+                            outputs={"Out": [gnorm]})
+            # scale = clip_norm / max(gnorm, clip_norm): never divides by
+            # zero and caps at 1 (reference clip.py GradientClipByGlobalNorm)
+            denom = block.create_var(
+                name=framework.unique_name("clip_denom"),
+                dtype=grad.dtype, shape=(1,))
+            block.append_op(type="clip", inputs={"X": [gnorm]},
+                            outputs={"Out": [denom]},
+                            attrs={"min": self.clip_norm,
+                                   "max": float("inf")})
+            clip_const = block.create_var(
+                name=framework.unique_name("clip_norm_const"),
+                dtype=grad.dtype, shape=(1,))
+            block.append_op(type="fill_constant",
+                            outputs={"Out": [clip_const]},
+                            attrs={"shape": [1], "value": self.clip_norm,
+                                   "dtype": grad.dtype})
+            scale = block.create_var(
+                name=framework.unique_name("clip_scale"),
+                dtype=grad.dtype, shape=(1,))
+            block.append_op(type="elementwise_div",
+                            inputs={"X": [clip_const], "Y": [denom]},
+                            outputs={"Out": [scale]}, attrs={"axis": -1})
+            self.context[self.group_name] = [(scale,)]
+        scale = self.context[self.group_name][0][0]
+        out = block.create_var(
+            name=framework.unique_name(grad.name + "_clip"),
+            dtype=grad.dtype, shape=grad.shape)
+        block.append_op(type="elementwise_mul",
+                        inputs={"X": [grad], "Y": [scale]},
+                        outputs={"Out": [out]}, attrs={"axis": -1})
+        return param, out
+
+
+def append_gradient_clip_ops(param_grad):
+    """reference: clip.py append_gradient_clip_ops."""
+    context = {}
+    clip_attrs = []
+    for p, g in param_grad:
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None:
+            clip_attr = NullGradientClipAttr()
+        clip_attrs.append(clip_attr)
+        clip_attr.process_context(context=context, param=p, grad=g)
+
+    res = []
+    ops = []
+    for (p, g), clip_attr in zip(param_grad, clip_attrs):
+        if g is None:
+            res.append((p, g))
+            continue
+        res.append(clip_attr.create_operators(param=p, grad=g))
+    return res, ops
+
+
+def error_clip_callback(block, context):
+    op_desc = block.desc.ops[-1]
+    for grad_n in op_desc.output_names():
+        fwd_var = block.var_recursive(grad_n.replace("@GRAD", ""))
+        error_clip = getattr(fwd_var, "error_clip", None)
+        if error_clip is not None:
+            error_clip.append_clip_op(block, grad_n)

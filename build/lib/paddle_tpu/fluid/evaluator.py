@@ -1,0 +1,215 @@
+"""In-graph streaming metrics.
+
+Capability parity with the reference's stateful evaluators (reference:
+python/paddle/v2/fluid/evaluator.py — Accuracy, ChunkEvaluator;
+gserver/evaluators/Evaluator.cpp for the CTC/mAP variants), re-designed
+for this runtime rather than transcribed: each metric owns persistable
+counter variables that the main program accumulates into **on device**
+(one fused add per batch, riding the compiled step), while `reset()`
+and `eval()` are **host-side scope operations** — the scope here is a
+host dict of device buffers, so zeroing a counter is a store and the
+final precision/recall/ratio arithmetic is a handful of scalar divides
+that have no business inside an XLA program.  The reference instead
+builds dedicated reset/eval sub-programs and clones state vars into
+them; that machinery buys nothing on this runtime and is gone.
+"""
+
+import numpy as np
+
+from .framework import unique_name
+from .layer_helper import LayerHelper
+from .initializer import Constant
+from ..core.scope import global_scope
+from ..core.types import np_dtype
+from . import layers
+
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "DetectionMAP",
+           "Evaluator"]
+
+
+class Evaluator:
+    """Base: counter plumbing shared by all streaming metrics.
+
+    Subclasses append their per-batch ops at construction time (so the
+    counters update as part of the normal training/eval step) and
+    implement `_combine(reads)` mapping counter values to the metric.
+    """
+
+    def __init__(self, prefix, **kwargs):
+        self.helper = LayerHelper(prefix, **kwargs)
+        if self.helper.main_program.current_block().idx != 0:
+            raise ValueError(
+                "streaming metrics accumulate into top-level counters; "
+                "construct the evaluator outside any sub-block")
+        self.metrics = []   # per-batch metric Variables (fetchable)
+        self.states = []    # accumulator Variables (persistable)
+
+    # -- counter plumbing ------------------------------------------------
+
+    def _counter(self, tag, dtype="int32", shape=(1,)):
+        """A persistable accumulator ([1]-shaped unless a per-class
+        shape is asked for), zero-initialized by the startup program."""
+        var = self.helper.create_variable(
+            name=unique_name("%s.%s" % (self.helper.name, tag)),
+            persistable=True, dtype=dtype, shape=list(shape))
+        self.helper.set_variable_initializer(var, Constant(0.0))
+        self.states.append(var)
+        return var
+
+    def _accumulate(self, counter, amount):
+        """counter += amount, on device, as part of the main program."""
+        if amount.dtype != counter.dtype:
+            amount = layers.cast(amount, dtype=counter.dtype)
+        self.helper.append_op(type="sum",
+                              inputs={"X": [counter, amount]},
+                              outputs={"Out": [counter]})
+
+    def _reads(self, scope):
+        """Host values of all counters, in registration order."""
+        return [np.asarray(scope.get(v.name)) for v in self.states]
+
+    # -- public API ------------------------------------------------------
+
+    def reset(self, executor, reset_program=None):
+        """Zero every counter.  Direct host stores into the scope; the
+        `executor`/`reset_program` arguments are accepted for drop-in
+        compatibility with the reference signature but no program run
+        is needed on this runtime."""
+        scope = global_scope()
+        for var in self.states:
+            scope.set(var.name,
+                      np.zeros([int(d) for d in var.shape] or [1],
+                               np_dtype(var.dtype)))
+
+    def eval(self, executor, eval_program=None):
+        return self._combine(self._reads(global_scope()))
+
+    def _combine(self, reads):
+        raise NotImplementedError(type(self).__name__)
+
+    # compat shim for code written against the reference's method name
+    def create_state(self, suffix, dtype, shape):
+        return self._counter(suffix, dtype=dtype, shape=shape)
+
+
+def _ratio(num, den):
+    return float(num) / float(den) if den else 0.0
+
+
+class Accuracy(Evaluator):
+    """Streaming top-k accuracy: correct/total over every batch since
+    the last reset (reference: fluid/evaluator.py Accuracy on top of
+    accuracy_op.h)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super().__init__("accuracy", **kwargs)
+        self.correct = self._counter("correct")
+        self.total = self._counter("total")
+        batch_correct = self.helper.create_tmp_variable(
+            dtype="int32", stop_gradient=True)
+        batch_total = self.helper.create_tmp_variable(
+            dtype="int32", stop_gradient=True)
+        batch_acc = layers.accuracy(input=input, label=label, k=k,
+                                    correct=batch_correct,
+                                    total=batch_total)
+        self._accumulate(self.correct, batch_correct)
+        self._accumulate(self.total, batch_total)
+        self.metrics.append(batch_acc)
+
+    def _combine(self, reads):
+        correct, total = (r.sum() for r in reads)
+        return np.array([_ratio(correct, total)], np.float32)
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk-level precision/recall/F1 (reference:
+    fluid/evaluator.py ChunkEvaluator over chunk_eval_op)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, **kwargs):
+        super().__init__("chunk_eval", **kwargs)
+        self.num_infer = self._counter("infer_chunks")
+        self.num_label = self._counter("label_chunks")
+        self.num_correct = self._counter("correct_chunks")
+        (precision, recall, f1,
+         batch_infer, batch_label, batch_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self._accumulate(self.num_infer, batch_infer)
+        self._accumulate(self.num_label, batch_label)
+        self._accumulate(self.num_correct, batch_correct)
+        self.metrics.extend([precision, recall, f1])
+
+    def _combine(self, reads):
+        infer, label, correct = (r.sum() for r in reads)
+        precision = _ratio(correct, infer)
+        recall = _ratio(correct, label)
+        f1 = (2 * precision * recall / (precision + recall)
+              if correct else 0.0)
+        return (np.array([precision]), np.array([recall]),
+                np.array([f1]))
+
+
+class EditDistance(Evaluator):
+    """Streaming edit distance / sequence error rate (reference:
+    gserver/evaluators/CTCErrorEvaluator.cpp — total edit distance and
+    instance error rate).  `input` are hypothesis id sequences, `label`
+    the references."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super().__init__("edit_distance", **kwargs)
+        self.total_distance = self._counter("total_distance", "float32")
+        self.seq_num = self._counter("seq_num")
+        self.wrong_seqs = self._counter("wrong_seqs")
+        dist, batch_seqs = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        batch_dist = layers.reduce_sum(input=dist, dim=0, keep_dim=False)
+        # distances are >= 0, so sign(d) flags each wrong sequence
+        batch_wrong = layers.reduce_sum(
+            input=layers.sign(dist), dim=0, keep_dim=False)
+        self._accumulate(self.total_distance, batch_dist)
+        self._accumulate(self.seq_num, batch_seqs)
+        self._accumulate(self.wrong_seqs, batch_wrong)
+        self.metrics.append(dist)
+
+    def _combine(self, reads):
+        total, n, wrong = (r.sum() for r in reads)
+        return (np.array([_ratio(total, n)]),
+                np.array([_ratio(wrong, n)]))
+
+
+class DetectionMAP(Evaluator):
+    """Detection mean average precision (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp).  The detection_map
+    op scores each batch; eval() reports the UNWEIGHTED mean of batch
+    mAPs (the reference accumulates global per-class TP/FP across the
+    pass; the batch mean keeps the evaluator state in-graph and tracks
+    the same ranking signal, but differs numerically on uneven
+    batches)."""
+
+    def __init__(self, detect_res, label, overlap_threshold=0.5,
+                 background_id=0, ap_type="11point",
+                 evaluate_difficult=False, **kwargs):
+        super().__init__("detection_map", **kwargs)
+        self.map_sum = self._counter("map_sum", "float32")
+        self.batches = self._counter("batches", "float32")
+        batch_map = self.helper.create_tmp_variable(
+            dtype="float32", stop_gradient=True)
+        self.helper.append_op(
+            type="detection_map",
+            inputs={"DetectRes": [detect_res], "Label": [label]},
+            outputs={"MAP": [batch_map]},
+            attrs={"overlap_threshold": float(overlap_threshold),
+                   "background_label_id": int(background_id),
+                   "ap_type": ap_type,
+                   "evaluate_difficult": bool(evaluate_difficult)})
+        self._accumulate(self.map_sum, batch_map)
+        self._accumulate(
+            self.batches,
+            layers.fill_constant(shape=[1], dtype="float32", value=1.0))
+        self.metrics.append(batch_map)
+
+    def _combine(self, reads):
+        map_sum, batches = (r.sum() for r in reads)
+        return np.array([_ratio(map_sum, batches)])

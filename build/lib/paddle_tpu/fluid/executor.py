@@ -1,0 +1,570 @@
+"""Executor: compiles whole program blocks with XLA and runs them.
+
+TPU-native re-design of the reference executor
+(reference: paddle/framework/executor.cc:79 Executor::Run — an op-by-op
+interpreter; python/paddle/v2/fluid/executor.py:149).
+
+The reference interprets one op at a time, dispatching a device kernel per
+op (executor.cc:119-137).  On TPU that model wastes the compiler: instead we
+*lower the whole block to one jitted JAX function* — every op kernel is pure
+JAX, so XLA fuses the full forward+backward+optimizer program into a single
+executable, with parameters donated for in-place buffer reuse.  Ops that
+must touch the host (print/save/load/send/recv/feed/fetch) split the block
+into maximal jittable segments, preserving the reference's interleaved
+semantics.  An eager per-op mode (`run(..., eager=True)`) reproduces the
+reference's interpreter for debugging, per-op profiling and nan checks
+(reference: executor.cc:29 FLAGS_check_nan_inf).
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.scope import Scope, global_scope
+from ..core.ragged import RaggedTensor, SelectedRows
+from ..core.types import np_dtype, VarType
+from ..ops import registry as op_registry
+from ..utils import flags
+from . import framework
+from . import profiler as profiler_mod
+
+
+def _check_outputs_finite(op_desc, outs):
+    """Eager-mode NaN/Inf scan of op outputs (reference: executor.cc:29
+    FLAGS_check_nan_inf + CheckTensorNANOrInf executor.cc:66-77)."""
+    for slot, vals in (outs or {}).items():
+        for val in (vals or []):
+            arr = getattr(val, "values", val)
+            if arr is None or not hasattr(arr, "dtype"):
+                continue
+            if not np.issubdtype(np.dtype(arr.dtype), np.floating):
+                continue
+            host = np.asarray(arr)  # one device->host copy per output
+            if not np.all(np.isfinite(host)):
+                raise FloatingPointError(
+                    "NaN/Inf in output slot %r of op %r"
+                    % (slot, op_desc.type))
+
+__all__ = ["Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
+           "global_scope", "scope_guard", "fetch_var"]
+
+RNG_STATE_NAME = "@RNG_STATE@"
+
+
+# ---------------------------------------------------------------------------
+# Places (reference: paddle/platform/place.h:24-55)
+# ---------------------------------------------------------------------------
+
+class Place:
+    def device(self):
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items()))))
+
+
+class CPUPlace(Place):
+    def device(self):
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return jax.devices()[0]
+
+    def __repr__(self):
+        return "CPUPlace()"
+
+
+class TPUPlace(Place):
+    """The accelerator place.  reference: CUDAPlace (place.h:34) — on this
+    framework the accelerator is whatever JAX's default backend exposes
+    (a TPU chip in production)."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def device(self):
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self):
+        return "TPUPlace(%d)" % self.device_id
+
+
+# API-compat alias: reference tests construct fluid.CUDAPlace(0)
+CUDAPlace = TPUPlace
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    from ..core import scope as scope_mod
+
+    old = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    try:
+        yield
+    finally:
+        scope_mod._global_scope = old
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    scope = scope or global_scope()
+    val = scope.get(name)
+    if return_numpy and isinstance(val, jax.Array):
+        return np.asarray(val)
+    return val
+
+
+# ---------------------------------------------------------------------------
+# Execution context passed to kernels
+# ---------------------------------------------------------------------------
+
+class ExecContext:
+    """Handed to every kernel.  Carries the RNG stream and sub-block
+    lowering for control-flow ops; pure ops ignore it."""
+
+    def __init__(self, executor_like, program, block_idx, env, rng=None,
+                 scope=None, place=None):
+        self._exec = executor_like
+        self.program = program
+        self.block_idx = block_idx
+        self.env = env
+        self._rng = rng
+        self.scope = scope
+        self.place = place
+
+    def next_rng(self):
+        if self._rng is None:
+            raise RuntimeError("op needs RNG but segment has no rng state")
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    @property
+    def rng(self):
+        return self._rng
+
+    def run_block(self, block_idx, env):
+        """Run all ops of a sub-block in-trace against `env` (a dict the
+        caller seeds with the sub-block's inputs).  Returns the env.
+        This is how control-flow kernels (scan/cond bodies) lower their
+        sub-blocks (reference: while_op.cc:48-63 runs a nested Executor)."""
+        block_desc = self.program.desc.block(block_idx)
+        sub = ExecContext(self._exec, self.program, block_idx, env,
+                          rng=self._rng, scope=self.scope, place=self.place)
+        for op_desc in block_desc.ops:
+            apply_op(sub, op_desc)
+        self._rng = sub._rng
+        return env
+
+
+def _env_get(ctx, name):
+    env = ctx.env
+    if name in env:
+        return env[name]
+    # a TensorArray read before any write is legal (first array_write
+    # creates it); everything else must be fed/persistable/produced
+    vd = _find_var_desc_or_none(ctx.program, ctx.block_idx, name)
+    if vd is not None and vd.type == VarType.TENSOR_ARRAY:
+        return None
+    raise KeyError("variable %r is not initialized (op inputs must be fed, "
+                   "persistable, or produced earlier in the block)" % name)
+
+
+def _find_var_desc_or_none(program, block_idx, name):
+    bd = program.desc.block(block_idx)
+    while True:
+        if name in bd.vars:
+            return bd.vars[name]
+        if bd.parent_idx < 0:
+            return None
+        bd = program.desc.block(bd.parent_idx)
+
+
+def apply_op(ctx, op_desc):
+    """Apply one op's kernel against ctx.env (pure; used both under trace
+    and eagerly)."""
+    t = op_desc.type
+    if op_registry.has_op(t):
+        info = op_registry.get_op_info(t)
+        kernel = info.kernel
+        is_generic_grad = False
+    elif op_registry.is_grad_op_type(t) and \
+            op_registry.has_op(op_registry.forward_type_of_grad(t)):
+        info = op_registry.get_op_info(op_registry.forward_type_of_grad(t))
+        kernel = info.grad_kernel
+        is_generic_grad = kernel is None
+    else:
+        raise KeyError("operator %r is not registered" % t)
+
+    ins = {}
+    for slot, names in op_desc.inputs.items():
+        ins[slot] = [None if n == "@EMPTY@" else _env_get(ctx, n)
+                     for n in names]
+
+    if is_generic_grad:
+        outs = op_registry.run_generic_grad(
+            ctx, op_registry.forward_type_of_grad(t), ins, op_desc.attrs)
+    else:
+        outs = kernel(ctx, ins, op_desc.attrs)
+
+    for slot, names in op_desc.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for name, val in zip(names, vals):
+            if val is None or name == "@EMPTY@":
+                continue
+            ctx.env[name] = val
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Block lowering
+# ---------------------------------------------------------------------------
+
+def _op_jittable(op_desc):
+    t = op_desc.type
+    if op_registry.has_op(t):
+        return op_registry.get_op_info(t).jittable
+    if op_registry.is_grad_op_type(t):
+        ft = op_registry.forward_type_of_grad(t)
+        if op_registry.has_op(ft):
+            return op_registry.get_op_info(ft).jittable
+    raise KeyError("operator %r is not registered" % t)
+
+
+def _op_uses_rng(op_desc):
+    t = op_desc.type
+    if op_registry.has_op(t):
+        return op_registry.get_op_info(t).uses_rng
+    return False
+
+
+def _segment_block(op_descs):
+    """Split into (jittable: bool, [op_desc]) runs."""
+    segments = []
+    for od in op_descs:
+        j = _op_jittable(od)
+        if segments and segments[-1][0] == j:
+            segments[-1][1].append(od)
+        else:
+            segments.append((j, [od]))
+    return segments
+
+
+class _CompiledProgram:
+    """A lowered program: a list of segment runners sharing a host-side env.
+
+    Compile-key granularity: the python structure here depends only on
+    (program version, feed names, fetch names); jax.jit inside re-
+    specializes per feed shapes/dtypes automatically.
+    """
+
+    def __init__(self, executor, program, block_idx, feed_names, fetch_names):
+        self.executor = executor
+        self.program = program
+        self.block_idx = block_idx
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        block_desc = program.desc.block(block_idx)
+        self.segments = _segment_block(block_desc.ops)
+        self._jit_cache = {}
+        self._plan = self._analyze()
+
+    # -- data-flow analysis -------------------------------------------------
+    def _analyze(self):
+        block_desc = self.program.desc.block(self.block_idx)
+        prog_desc = self.program.desc
+
+        def find_vd(name):
+            bd = block_desc
+            while True:
+                if name in bd.vars:
+                    return bd.vars[name]
+                if bd.parent_idx < 0:
+                    return None
+                bd = prog_desc.block(bd.parent_idx)
+
+        plan = []
+        produced_before = set(self.feed_names)
+        # names needed after each segment: fetches + anything read later
+        later_reads = [set(self.fetch_names)]
+        for (j, ops) in reversed(self.segments):
+            reads = set()
+            for od in ops:
+                reads.update(od.input_names())
+            later_reads.append(later_reads[-1] | reads)
+        later_reads = list(reversed(later_reads))  # later_reads[i+1] = after seg i
+
+        for i, (jit_ok, ops) in enumerate(self.segments):
+            reads, writes, rng = [], [], False
+            seen_writes = set()
+            for od in ops:
+                for n in od.input_names():
+                    if n not in seen_writes and n not in reads:
+                        reads.append(n)
+                for n in od.output_names():
+                    if n != "@EMPTY@":
+                        seen_writes.add(n)
+                        if n not in writes:
+                            writes.append(n)
+                rng = rng or _op_uses_rng(od)
+            persist_writes = [
+                n for n in writes
+                if (find_vd(n) is not None and find_vd(n).persistable)]
+            # outputs that must leave the segment
+            needed_later = later_reads[i + 1]
+            out_names = [n for n in writes
+                         if n in needed_later or n in persist_writes]
+            plan.append({
+                "jit": jit_ok, "ops": ops, "reads": reads,
+                "writes": writes, "outputs": out_names,
+                "persist_writes": persist_writes, "rng": rng,
+            })
+        return plan
+
+    # -- execution ----------------------------------------------------------
+    def run(self, scope, feed_env, eager=False):
+        executor = self.executor
+        program = self.program
+        env = dict(feed_env)
+
+        def resolve(name):
+            if name in env:
+                return env[name]
+            val = scope.get(name)
+            if val is None:
+                raise RuntimeError(
+                    "variable %r is not initialized; run the startup "
+                    "program first" % name)
+            return val
+
+        rng_state = scope.get(RNG_STATE_NAME)
+        if rng_state is None:
+            # committed placement, like the jit-returned key that will
+            # replace it: an uncommitted first key makes every jitted
+            # segment retrace (and recompile) on its second run
+            rng_state = jax.device_put(
+                jax.random.PRNGKey(self.program.random_seed or 0),
+                executor.place.device())
+            scope.set_local(RNG_STATE_NAME, rng_state)
+
+        for i, seg in enumerate(self._plan):
+            in_vals = {n: resolve(n) for n in seg["reads"] if n in env
+                       or scope.has_var(n)}
+            if seg["jit"] and not eager:
+                out_vals, rng_state = self._run_jit_segment(
+                    i, seg, in_vals, rng_state)
+            else:
+                ctx = ExecContext(executor, program, self.block_idx,
+                                  dict(in_vals), rng=rng_state, scope=scope,
+                                  place=executor.place)
+                for od in seg["ops"]:
+                    # per-op attribution like the reference interpreter
+                    # (reference: executor.cc:126-127 RecordEvent per op,
+                    # executor.cc:29+66-77 FLAGS_check_nan_inf scan)
+                    with profiler_mod.record_event(od.type):
+                        outs = apply_op(ctx, od)
+                    if flags.get_flag("check_nan_inf"):
+                        _check_outputs_finite(od, outs)
+                rng_state = ctx.rng
+                out_vals = {n: ctx.env[n] for n in seg["outputs"]
+                            if n in ctx.env}
+            env.update(out_vals)
+            for n in seg["persist_writes"]:
+                if n in out_vals:
+                    scope.set(n, out_vals[n])
+        scope.set(RNG_STATE_NAME, rng_state)
+
+        # fetches not written this run (parameters, accumulated state)
+        # resolve from the scope, matching the reference's
+        # GetFetchVariable-on-scope semantics
+        return [env[n] if n in env else scope.get(n)
+                for n in self.fetch_names]
+
+    def _segment_label(self, i, seg):
+        """Stable display name: index + op-type span + op count."""
+        types = [od.type for od in seg["ops"]]
+        span = types[0] if len(types) == 1 else "%s..%s" % (types[0],
+                                                            types[-1])
+        return "jit_segment[%d:%s x%d]" % (i, span, len(types))
+
+    def _run_jit_segment(self, i, seg, in_vals, rng_state):
+        first_call = i not in self._jit_cache
+        jitted = self._jit_cache.get(i)
+        if jitted is None:
+            ops = seg["ops"]
+            out_names = tuple(seg["outputs"])
+            program = self.program
+            block_idx = self.block_idx
+            executor = self.executor
+            mutated = tuple(n for n in seg["outputs"] if n in seg["reads"])
+
+            def segment_fn(mut_ins, ro_ins, rng):
+                env = dict(ro_ins)
+                env.update(mut_ins)
+                ctx = ExecContext(executor, program, block_idx, env, rng=rng)
+                for od in ops:
+                    apply_op(ctx, od)
+                outs = {n: env[n] for n in out_names if n in env}
+                return outs, ctx.rng
+
+            jitted = {
+                "fn": jax.jit(segment_fn, donate_argnums=(0,)),
+                "mutated": mutated,
+            }
+            self._jit_cache[i] = jitted
+
+        mutated = jitted["mutated"]
+        mut_ins = {n: v for n, v in in_vals.items() if n in mutated}
+        ro_ins = {n: v for n, v in in_vals.items() if n not in mutated}
+        if not profiler_mod.is_enabled():
+            outs, rng = jitted["fn"](mut_ins, ro_ins, rng_state)
+            return outs, rng
+        # profiled: block on the segment's outputs so the wall time is
+        # the device time, not just the dispatch (ParseEvents analog for
+        # the compiled path; per-op rows come from eager mode).  A trace
+        # hit (new shapes/dtypes) also lands in the /first(trace) row.
+        label = self._segment_label(i, seg)
+        pre_traces = getattr(jitted["fn"], "_cache_size", lambda: None)()
+        t0 = time.perf_counter()
+        outs, rng = jitted["fn"](mut_ins, ro_ins, rng_state)
+        jax.block_until_ready((outs, rng))
+        dt = time.perf_counter() - t0
+        traced = first_call or (
+            pre_traces is not None
+            and jitted["fn"]._cache_size() > pre_traces)
+        profiler_mod.record(
+            label + ("/first(trace)" if traced else ""), dt)
+        return outs, rng
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+def guard_int64_narrowing(arr, name="feed"):
+    """int64 host arrays execute as int32 (JAX x64 disabled).  Make the
+    narrowing LOUD when it would actually wrap — embedding/beam ids
+    beyond 2^31 would silently corrupt lookups otherwise.  Used by the
+    executor feed path; reader.device_prefetch sidesteps the issue by
+    keeping int64 feeds on host (see reader/prefetch.py)."""
+    if getattr(arr, "dtype", None) == np.int64 and arr.size \
+            and (arr.max() > np.iinfo(np.int32).max
+                 or arr.min() < np.iinfo(np.int32).min):
+        raise OverflowError(
+            "feed %r: int64 values exceed int32 range (JAX x64 is "
+            "disabled); ids must stay below 2^31" % name)
+
+
+class Executor:
+    """reference: python/paddle/v2/fluid/executor.py:149 + executor.cc:79."""
+
+    _CACHE_MAX = 64
+
+    def __init__(self, place=None):
+        if isinstance(place, (list, tuple)):
+            place = place[0]
+        self.place = place or TPUPlace(0)
+        # LRU-bounded: per-call Programs (evaluator eval/reset) would
+        # otherwise grow this without bound
+        from collections import OrderedDict
+
+        self._cache = OrderedDict()
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_program_cache=True, eager=False):
+        if program is None:
+            program = framework.default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [f.name if isinstance(f, framework.Variable) else str(f)
+                       for f in fetch_list]
+
+        feed_env = {}
+        block0 = program.desc.block(0)
+        for name, val in feed.items():
+            feed_env[name] = self._prepare_feed(block0, name, val)
+
+        # dtype policy is trace-time state: a flipped amp flag must not
+        # reuse executables traced under the old policy
+        key = (program._cache_token, program.version, 0,
+               tuple(sorted(feed_env.keys())), tuple(fetch_names),
+               flags.get_flag("amp_bf16"), flags.get_flag("amp_bf16_act"))
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = _CompiledProgram(self, program, 0,
+                                        sorted(feed_env.keys()), fetch_names)
+            if use_program_cache:
+                self._cache[key] = compiled
+                while len(self._cache) > self._CACHE_MAX:
+                    self._cache.popitem(last=False)
+        elif use_program_cache:
+            self._cache.move_to_end(key)
+
+        results = compiled.run(scope, feed_env, eager=eager)
+
+        if return_numpy:
+            results = [self._to_numpy(r) for r in results]
+        return results
+
+    def _prepare_feed(self, block_desc, name, val):
+        if isinstance(val, (RaggedTensor, SelectedRows)):
+            return val
+        if isinstance(val, (list, tuple)) and any(
+                isinstance(v, (RaggedTensor, SelectedRows))
+                for v in val):
+            # host array-of-tensors feed (e.g. beam_search_decode steps)
+            return list(val)
+        vd = block_desc.vars.get(name)
+        if isinstance(val, jax.Array):
+            # pre-placed feed (reader.device_prefetch): keep it on
+            # device — no host round-trip; the int64 guard already ran
+            # before the worker-thread device_put
+            target = (np_dtype(vd.dtype) if vd is not None
+                      and vd.dtype is not None else None)
+            if target is not None and val.dtype != target \
+                    and target != np.dtype(np.int64):
+                val = val.astype(target)
+            return jax.device_put(val, self.place.device())
+        arr = np.asarray(val)
+        # int64 feeds execute as int32 (JAX x64 disabled): when the
+        # target dtype actually narrows to int32, check the range
+        # BEFORE the astype so overflow is LOUD instead of silently
+        # wrapping ids (embedding/beam ids beyond 2^31 would corrupt
+        # lookups).  Feeds into float vars keep casting as before.
+        target = (np_dtype(vd.dtype) if vd is not None
+                  and vd.dtype is not None else np.dtype(np.int32))
+        if target == np.int32:
+            guard_int64_narrowing(arr, name)
+        if vd is not None and vd.dtype is not None:
+            arr = arr.astype(np_dtype(vd.dtype), copy=False)
+        elif arr.dtype == np.int64:
+            arr = arr.astype(np.int32)
+        return jax.device_put(arr, self.place.device())
+
+    @staticmethod
+    def _to_numpy(r):
+        if r is None:
+            return None
+        if isinstance(r, RaggedTensor):
+            if r.values.dtype == jnp.bfloat16:
+                r = r.with_values(r.values.astype(jnp.float32))
+            return r
+        arr = np.asarray(r)
+        if arr.dtype == jnp.bfloat16:
+            # bf16 is an internal compute dtype (FLAGS_amp_bf16_act);
+            # the feed/fetch contract stays f32
+            arr = arr.astype(np.float32)
+        return arr

@@ -1,0 +1,527 @@
+"""Program IR builder: Variable / Operator / Block / Program / Parameter.
+
+TPU-native re-design of the reference Python IR mirror
+(reference: python/paddle/v2/fluid/framework.py — Variable:125,
+Operator:350, Block:621, Program:789).  Unlike the reference there is no
+C++ Desc object graph behind this: the descs in `paddle_tpu.core.desc` ARE
+the IR, and the executor compiles whole blocks with XLA.
+
+Shape inference on append_op uses the registry's generic
+`jax.eval_shape`-based inference (see ops/registry.py) unless the op
+registers an explicit rule.
+"""
+
+import contextlib
+import copy
+import itertools
+
+from ..core.desc import ProgramDesc, BlockDesc, OpDesc, VarDesc, BlockRef
+from ..core.types import VarType, canonical_dtype
+from ..ops import registry as op_registry
+
+__all__ = [
+    "Variable", "Parameter", "Operator", "Block", "Program",
+    "default_main_program", "default_startup_program", "program_guard",
+    "switch_main_program", "switch_startup_program", "unique_name",
+    "grad_var_name",
+]
+
+
+_name_counters = {}
+
+
+def unique_name(prefix):
+    idx = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = idx + 1
+    return "%s_%d" % (prefix, idx)
+
+
+def reset_unique_name():
+    _name_counters.clear()
+
+
+def grad_var_name(name):
+    from ..core.types import grad_var_name as g
+
+    return g(name)
+
+
+class Variable:
+    """A symbolic variable inside a Block (reference: framework.py:125)."""
+
+    def __init__(self, block, name=None, shape=None, dtype=None,
+                 lod_level=None, persistable=None, stop_gradient=False,
+                 type=VarType.DENSE_TENSOR, **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name("_generated_var")
+        desc = block.desc.vars.get(name)
+        if desc is None:
+            desc = VarDesc(
+                name,
+                type=type,
+                dtype=canonical_dtype(dtype) if dtype is not None else "float32",
+                shape=shape if shape is not None else (),
+                lod_level=lod_level or 0,
+                persistable=bool(persistable),
+                stop_gradient=stop_gradient,
+            )
+            block.desc.vars[name] = desc
+        else:
+            # re-finding an existing var: update any newly-specified fields
+            if shape is not None:
+                desc.shape = tuple(int(s) for s in shape)
+            if dtype is not None:
+                desc.dtype = canonical_dtype(dtype)
+            if lod_level is not None:
+                desc.lod_level = lod_level
+            if persistable is not None:
+                desc.persistable = bool(persistable)
+        self.desc = desc
+        self.error_clip = kwargs.get("error_clip")
+
+    # -- desc accessors -----------------------------------------------------
+    @property
+    def name(self):
+        return self.desc.name
+
+    @property
+    def shape(self):
+        return tuple(self.desc.shape)
+
+    @property
+    def dtype(self):
+        return self.desc.dtype
+
+    @property
+    def lod_level(self):
+        return self.desc.lod_level
+
+    @property
+    def type(self):
+        return self.desc.type
+
+    @property
+    def persistable(self):
+        return self.desc.persistable
+
+    @persistable.setter
+    def persistable(self, p):
+        self.desc.persistable = bool(p)
+
+    @property
+    def stop_gradient(self):
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, s):
+        self.desc.stop_gradient = bool(s)
+
+    def __repr__(self):
+        return "Variable(%s)" % (self.desc,)
+
+    __str__ = __repr__
+
+
+class Parameter(Variable):
+    """A trainable persistable variable (reference: framework.py Parameter)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        if shape is None or dtype is None:
+            raise ValueError("Parameter needs shape and dtype")
+        for d in shape:
+            if d < 0:
+                raise ValueError("Parameter shape must be static: %s" % (shape,))
+        kwargs.setdefault("persistable", True)
+        Variable.__init__(self, block, shape=shape, dtype=dtype, **kwargs)
+        self.desc.is_parameter = True
+        self.trainable = kwargs.get("trainable", True)
+        self.optimize_attr = kwargs.get("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.get("regularizer", None)
+        self.gradient_clip_attr = kwargs.get("gradient_clip_attr", None)
+        self.init_info = kwargs.get("init_info", None)
+
+
+class Operator:
+    """Python view over an OpDesc (reference: framework.py:350)."""
+
+    def __init__(self, block, desc):
+        self.block = block
+        self.desc = desc
+
+    @property
+    def type(self):
+        return self.desc.type
+
+    def input(self, slot):
+        return self.desc.input(slot)
+
+    def output(self, slot):
+        return self.desc.output(slot)
+
+    @property
+    def input_names(self):
+        return list(self.desc.inputs.keys())
+
+    @property
+    def output_names(self):
+        return list(self.desc.outputs.keys())
+
+    def attr(self, name, default=None):
+        return self.desc.attr(name, default)
+
+    def set_attr(self, name, val):
+        self.desc.attrs[name] = val
+
+    @property
+    def attrs(self):
+        return self.desc.attrs
+
+    def __repr__(self):
+        return repr(self.desc)
+
+
+class Block:
+    """reference: framework.py:621."""
+
+    def __init__(self, program, idx, parent_idx=-1, desc=None):
+        self.program = program
+        if desc is None:
+            if idx == 0:
+                desc = program.desc.block(0)
+            else:
+                desc = program.desc.append_block(parent_idx)
+        self.desc = desc
+        self.vars = {}      # name -> Variable (python views)
+        self.ops = []       # list of Operator
+
+    @property
+    def idx(self):
+        return self.desc.idx
+
+    @property
+    def parent_idx(self):
+        return self.desc.parent_idx
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    def create_var(self, *args, **kwargs):
+        v = Variable(self, *args, **kwargs)
+        self.vars[v.name] = v
+        return v
+
+    def create_parameter(self, *args, **kwargs):
+        global_block = self.program.global_block()
+        p = Parameter(global_block, *args, **kwargs)
+        global_block.vars[p.name] = p
+        return p
+
+    def has_var(self, name):
+        return name in self.desc.vars
+
+    def has_var_recursive(self, name):
+        b = self
+        while b is not None:
+            if b.has_var(name):
+                return True
+            b = b.parent_block
+        return False
+
+    def var(self, name):
+        """Find a Variable in this block only (reference Block.var raises)."""
+        if name in self.vars:
+            return self.vars[name]
+        if name in self.desc.vars:
+            v = Variable(self, name=name)
+            self.vars[name] = v
+            return v
+        raise ValueError("var %r not in block %d" % (name, self.idx))
+
+    def var_recursive(self, name):
+        b = self
+        while b is not None:
+            if b.has_var(name):
+                return b.var(name)
+            b = b.parent_block
+        raise ValueError("var %r not found" % name)
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        """inputs/outputs: dict slot -> Variable | [Variable] | name | [name]."""
+        op_desc = OpDesc(
+            type,
+            {k: _var_names(v) for k, v in (inputs or {}).items() if v is not None},
+            {k: _var_names(v) for k, v in (outputs or {}).items() if v is not None},
+            attrs or {},
+        )
+        op = Operator(self, op_desc)
+        self.desc.ops.append(op_desc)
+        self.ops.append(op)
+        self.program._bump_version()
+        if infer_shape:
+            try:
+                infer_shape_for_op(self, op_desc)
+            except NotImplementedError:
+                pass
+        return op
+
+    def prepend_op(self, type=None, inputs=None, outputs=None, attrs=None,
+                   infer_shape=True):
+        op_desc = OpDesc(
+            type,
+            {k: _var_names(v) for k, v in (inputs or {}).items() if v is not None},
+            {k: _var_names(v) for k, v in (outputs or {}).items() if v is not None},
+            attrs or {},
+        )
+        op = Operator(self, op_desc)
+        self.desc.ops.insert(0, op_desc)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        if infer_shape:
+            try:
+                infer_shape_for_op(self, op_desc)
+            except NotImplementedError:
+                pass
+        return op
+
+    def sync_with_desc(self):
+        """Rebuild python Operator views after direct desc manipulation
+        (used by backward/transpilers that edit desc.ops in place)."""
+        self.ops = [Operator(self, od) for od in self.desc.ops]
+        for name in self.desc.vars:
+            if name not in self.vars:
+                self.vars[name] = Variable(self, name=name)
+        self.program._bump_version()
+
+    def __repr__(self):
+        lines = ["Block[%d] parent=%d" % (self.idx, self.parent_idx)]
+        for v in self.desc.vars.values():
+            lines.append("  " + repr(v))
+        for o in self.desc.ops:
+            lines.append("  " + repr(o))
+        return "\n".join(lines)
+
+
+def _var_names(v):
+    if isinstance(v, (list, tuple)):
+        return [x.name if isinstance(x, Variable) else str(x) for x in v]
+    return [v.name if isinstance(v, Variable) else str(v)]
+
+
+class Program:
+    """reference: framework.py:789."""
+
+    # process-wide monotonic id: unlike id(), never reused after GC, so
+    # executor caches keyed on it can never alias two programs
+    _token_counter = itertools.count()
+
+    def __init__(self):
+        self.desc = ProgramDesc()
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._seed_counter = 0
+        self._cache_token = next(Program._token_counter)
+
+    def _bump_version(self):
+        self._version += 1
+
+    @property
+    def version(self):
+        return self._version
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def create_block(self, parent_idx=None):
+        parent = (self.current_block_idx
+                  if parent_idx is None else parent_idx)
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    @contextlib.contextmanager
+    def block_guard(self, parent_idx=None):
+        b = self.create_block(parent_idx)
+        try:
+            yield b
+        finally:
+            self.rollback()
+
+    def clone(self, for_test=False):
+        """Deep-copies descs (reference: framework.py Program.clone).
+        for_test flips `is_test` on ops that have it (dropout, batch_norm)."""
+        p = Program()
+        p.desc = ProgramDesc.from_dict(copy.deepcopy(self.desc.to_dict()))
+        p.blocks = [Block(p, i, desc=bd) for i, bd in enumerate(p.desc.blocks)]
+        for b in p.blocks:
+            b.sync_with_desc()
+        # propagate python-side Parameter info
+        for name, var in self.global_block().vars.items():
+            if isinstance(var, Parameter) and p.global_block().has_var(name):
+                pv = p.global_block().vars[name]
+                newp = Parameter.__new__(Parameter)
+                newp.__dict__.update(pv.__dict__)
+                newp.trainable = var.trainable
+                newp.optimize_attr = var.optimize_attr
+                newp.regularizer = var.regularizer
+                newp.gradient_clip_attr = var.gradient_clip_attr
+                newp.init_info = getattr(var, "init_info", None)
+                p.global_block().vars[name] = newp
+        p.random_seed = self.random_seed
+        if for_test:
+            for b in p.blocks:
+                for op in b.desc.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+        return p
+
+    def to_string(self, throw_on_error=False):
+        return "\n".join(repr(b) for b in self.blocks)
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+    def list_vars(self):
+        for b in self.blocks:
+            for name in b.desc.vars:
+                yield b.var(name)
+
+    def serialize_to_string(self):
+        return self.desc.serialize_to_string()
+
+    @classmethod
+    def parse_from_string(cls, s):
+        p = cls()
+        p.desc = ProgramDesc.parse_from_string(s)
+        p.blocks = [Block(p, i, desc=bd) for i, bd in enumerate(p.desc.blocks)]
+        for b in p.blocks:
+            b.sync_with_desc()
+        return p
+
+
+def infer_shape_for_op(block, op_desc):
+    """Set output VarDescs' shape/dtype/lod via the registry."""
+    info = op_registry.get_op_info(op_desc.type)
+    if info.infer_shape is not None:
+        info.infer_shape(block, op_desc)
+        return
+    if not info.jittable:
+        # host kernels can't run under eval_shape; outputs keep their
+        # declared meta (reference: such ops hand-write InferShape)
+        return
+    if op_registry.is_grad_op_type(op_desc.type):
+        _grad_op_infer_shape(block, op_desc)
+        return
+    ins_meta = {}
+    for slot, names in op_desc.inputs.items():
+        metas = []
+        for n in names:
+            vd = _find_var_desc(block, n)
+            metas.append((vd.shape, vd.dtype, vd.lod_level, vd.type))
+        ins_meta[slot] = metas
+    outs = op_registry.generic_infer_shape(op_desc.type, ins_meta,
+                                           op_desc.attrs)
+    for slot, names in op_desc.outputs.items():
+        metas = outs.get(slot)
+        if metas is None:
+            continue
+        for n, meta in zip(names, metas):
+            (shape, dtype, lod), rest = meta[:3], meta[3:]
+            vd = _find_var_desc(block, n)
+            vd.shape = shape
+            vd.dtype = canonical_dtype(dtype)
+            vd.lod_level = lod
+            if rest:
+                vd.type = rest[0]
+
+
+def _grad_op_infer_shape(block, op_desc):
+    """X@GRAD has the same meta as X."""
+    from ..core.types import GRAD_SUFFIX
+
+    for slot, names in op_desc.outputs.items():
+        for n in names:
+            if n.endswith(GRAD_SUFFIX):
+                src = n[: -len(GRAD_SUFFIX)]
+                if _has_var_desc(block, src):
+                    svd = _find_var_desc(block, src)
+                    vd = _find_var_desc(block, n)
+                    vd.shape = svd.shape
+                    vd.dtype = svd.dtype
+                    vd.lod_level = svd.lod_level
+
+
+def _find_var_desc(block, name):
+    bd = block.desc
+    prog = block.program
+    while True:
+        if name in bd.vars:
+            return bd.vars[name]
+        if bd.parent_idx < 0:
+            raise KeyError("var desc %r not found from block %d"
+                           % (name, block.idx))
+        bd = prog.desc.block(bd.parent_idx)
+
+
+def _has_var_desc(block, name):
+    try:
+        _find_var_desc(block, name)
+        return True
+    except KeyError:
+        return False
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+def switch_main_program(p):
+    global _main_program
+    old, _main_program = _main_program, p
+    return old
+
+
+def switch_startup_program(p):
+    global _startup_program
+    old, _startup_program = _startup_program, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)

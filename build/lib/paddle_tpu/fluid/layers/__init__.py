@@ -1,0 +1,19 @@
+"""Layers namespace (reference: python/paddle/v2/fluid/layers/__init__.py)."""
+
+from . import math_op_patch  # applies Variable operator overloading
+from .nn import *            # noqa: F401,F403
+from .tensor import *        # noqa: F401,F403
+from .ops import *           # noqa: F401,F403
+from .io import *            # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .device import *        # noqa: F401,F403
+
+from . import nn, tensor, ops, io, control_flow, device
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += tensor.__all__
+__all__ += ops.__all__
+__all__ += io.__all__
+__all__ += control_flow.__all__
+__all__ += device.__all__

@@ -1,0 +1,704 @@
+"""Control-flow layers: While / StaticRNN / DynamicRNN / ConditionalBlock
+and tensor-array helpers.
+
+TPU-native equivalents of the reference control-flow DSL
+(reference: python/paddle/v2/fluid/layers/control_flow.py — While:602,
+StaticRNN:378, DynamicRNN:1252, ConditionalBlock:1065, array_write /
+array_read / array_length, less_than, increment).  The sub-blocks these
+build are lowered in-trace to lax.while_loop / lax.scan by the ops in
+ops/control_flow.py — not interpreted per-iteration like the reference's
+nested-Executor design (while_op.cc:48-63).
+"""
+
+import contextlib
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable, default_main_program, unique_name
+from ...core.desc import BlockRef
+from ...core.types import VarType
+
+__all__ = [
+    "While", "StaticRNN", "DynamicRNN", "ConditionalBlock", "less_than",
+    "array_write", "array_read", "array_length", "create_array",
+    "max_sequence_len", "lod_rank_table", "lod_tensor_to_array",
+    "array_to_lod_tensor", "shrink_memory", "reorder_lod_tensor_by_rank",
+    "split_lod_tensor", "merge_lod_tensor", "Print", "IfElse",
+    "ParallelDo", "equal",
+]
+
+
+def less_than(x, y, cond=None, **kwargs):
+    """reference: control_flow.py less_than, compare_op.cc."""
+    helper = LayerHelper("less_than", **kwargs)
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def equal(x, y, cond=None, **kwargs):
+    """reference: control_flow.py equal, compare_op.cc."""
+    helper = LayerHelper("equal", **kwargs)
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(type="equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def create_array(dtype, capacity=None, **kwargs):
+    """reference: control_flow.py create_array (LOD_TENSOR_ARRAY var)."""
+    helper = LayerHelper("array", **kwargs)
+    arr = helper.create_variable(
+        name=unique_name("array"), dtype=dtype,
+        type=VarType.TENSOR_ARRAY)
+    arr.capacity = capacity
+    return arr
+
+
+def array_write(x, i, array=None, capacity=None, **kwargs):
+    """reference: control_flow.py array_write,
+    tensor_array_read_write_op.cc."""
+    from ...core.tensor_array import DEFAULT_CAPACITY
+
+    helper = LayerHelper("array_write", **kwargs)
+    if array is None:
+        array = create_array(x.dtype)
+    cap = capacity or getattr(array, "capacity", None) or DEFAULT_CAPACITY
+    helper.append_op(
+        type="write_to_array",
+        inputs={"X": [x], "I": [i], "Array": [array]},
+        outputs={"Out": [array]},
+        attrs={"capacity": int(cap)})
+    return array
+
+
+def array_read(array, i, **kwargs):
+    helper = LayerHelper("array_read", **kwargs)
+    out = helper.create_tmp_variable(array.dtype)
+    helper.append_op(type="read_from_array",
+                     inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array, **kwargs):
+    helper = LayerHelper("array_length", **kwargs)
+    out = helper.create_tmp_variable(dtype="int64")
+    out.stop_gradient = True
+    helper.append_op(type="lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def max_sequence_len(rank_table, **kwargs):
+    helper = LayerHelper("max_seqence_len", **kwargs)
+    out = helper.create_tmp_variable(dtype="int64")
+    out.stop_gradient = True
+    helper.append_op(type="max_sequence_len",
+                     inputs={"RankTable": [rank_table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _block_reads_writes(block):
+    """(reads-from-outside, writes) of a built sub-block."""
+    produced = set()
+    reads, writes = [], []
+    for op in block.desc.ops:
+        for n in op.input_names():
+            if n != "@EMPTY@" and n not in produced and n not in reads:
+                reads.append(n)
+        for n in op.output_names():
+            if n != "@EMPTY@":
+                produced.add(n)
+                if n not in writes:
+                    writes.append(n)
+    # names declared in the sub-block itself are internal
+    local = set(block.desc.vars.keys())
+    outer_reads = [n for n in reads if n not in local or n in writes]
+    outer_reads = [n for n in outer_reads
+                   if block.parent_block.has_var_recursive(n)]
+    return outer_reads, writes
+
+
+class While:
+    """reference: control_flow.py While:602.
+
+    cond must be a bool scalar Variable, re-assigned inside the block.
+    `max_steps` bounds the loop and makes it reverse-differentiable
+    (lowered to lax.scan instead of lax.while_loop).
+    """
+
+    def __init__(self, cond, max_steps=None, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.max_steps = max_steps
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program.create_block()
+        yield
+        program.rollback()
+
+        outer_reads, writes = _block_reads_writes(sub_block)
+        cond_name = self.cond_var.name
+        # loop state: vars written in the block that live outside it
+        carry = [n for n in writes
+                 if parent_block.has_var_recursive(n)]
+        if cond_name not in carry:
+            carry.append(cond_name)
+        x_names = list(dict.fromkeys(outer_reads + carry))
+
+        parent_block.append_op(
+            type="while",
+            inputs={"X": x_names, "Condition": [cond_name]},
+            outputs={"Out": list(carry)},
+            attrs={"sub_block": BlockRef(sub_block.idx),
+                   "x_names": x_names, "carry_names": list(carry),
+                   "cond_name": cond_name,
+                   "max_steps": self.max_steps},
+            infer_shape=False)
+
+
+class ConditionalBlock:
+    """reference: control_flow.py ConditionalBlock:1065."""
+
+    def __init__(self, inputs, is_scalar_condition=True, name=None):
+        for i in inputs:
+            assert isinstance(i, Variable)
+        self.inputs = inputs
+        self.is_scalar_condition = is_scalar_condition
+        self.helper = LayerHelper("conditional_block", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program.create_block()
+        yield
+        program.rollback()
+
+        outer_reads, writes = _block_reads_writes(sub_block)
+        out_names = [n for n in writes
+                     if parent_block.has_var_recursive(n)]
+        x_names = list(dict.fromkeys(outer_reads + out_names))
+
+        parent_block.append_op(
+            type="conditional_block",
+            inputs={"X": x_names,
+                    "Cond": [self.inputs[0].name]},
+            outputs={"Out": list(out_names)},
+            attrs={"sub_block": BlockRef(sub_block.idx),
+                   "x_names": x_names, "out_names": list(out_names),
+                   "is_scalar_condition": self.is_scalar_condition},
+            infer_shape=False)
+
+
+class StaticRNN:
+    """Fixed-length RNN over dense [batch, T, ...] inputs.
+
+    reference: control_flow.py StaticRNN:378 (backed by recurrent_op.cc);
+    here the step block becomes one lax.scan body.
+    """
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = self.BEFORE_RNN_BLOCK
+        self.seq_inputs = []      # (outer Variable [B,T,...], step var)
+        self.memories = []        # dicts: boot (outer), pre (step), post
+        self.step_outputs = []    # step vars
+        self.outputs = []         # outer Variables [B,T,...]
+        self.sub_block = None
+        self.seq_len = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self.parent_block = program.current_block()
+        self.sub_block = program.create_block()
+        self.status = self.IN_RNN_BLOCK
+        yield
+        self.status = self.AFTER_RNN_BLOCK
+        program.rollback()
+        self._complete()
+
+    def _assert_in_rnn(self):
+        if self.status != self.IN_RNN_BLOCK:
+            raise ValueError("must be called inside rnn.step()")
+
+    def step_input(self, x):
+        """x: [batch, T, ...] dense; returns the per-step [batch, ...]
+        view inside the block."""
+        self._assert_in_rnn()
+        if self.seq_len is None:
+            self.seq_len = x.shape[1]
+        ipt = self.sub_block.create_var(
+            name=unique_name("@".join([self.helper.name, "step_in"])),
+            dtype=x.dtype,
+            shape=(x.shape[0],) + tuple(x.shape[2:]))
+        self.seq_inputs.append((x, ipt))
+        return ipt
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               dtype="float32", init_batch_dim_idx=0, ref_batch_dim_idx=0):
+        """Loop-carried state.  init: outer Variable with the initial
+        value; otherwise zeros of [batch_ref.shape[0]] + shape."""
+        self._assert_in_rnn()
+        from . import tensor as tensor_layers
+
+        if init is not None and init_batch_dim_idx != 0:
+            raise ValueError(
+                "init_batch_dim_idx != 0 is not supported: memories are "
+                "batch-major ([batch, ...]) in this framework")
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init or (shape, batch_ref)")
+            # resolve a step-input ref back to its outer (batch-major)
+            # var, whose batch dim is 0; for a direct outer ref honor
+            # ref_batch_dim_idx
+            outer_ref, ref_dim = batch_ref, ref_batch_dim_idx
+            for x, ipt in self.seq_inputs:
+                if batch_ref.name == ipt.name:
+                    outer_ref, ref_dim = x, 0
+                    break
+            parent_prog = self.helper.main_program
+            cur = parent_prog.current_block_idx
+            parent_prog.current_block_idx = self.parent_block.idx
+            try:
+                init = tensor_layers.fill_constant_batch_size_like(
+                    input=outer_ref, shape=[1] + list(shape), value=value,
+                    dtype=dtype, input_dim_idx=ref_dim)
+            finally:
+                parent_prog.current_block_idx = cur
+        pre = self.sub_block.create_var(
+            name=unique_name("@".join([self.helper.name, "mem"])),
+            dtype=init.dtype, shape=init.shape)
+        self.memories.append({"boot": init, "pre": pre, "post": None})
+        return pre
+
+    def update_memory(self, mem, var):
+        self._assert_in_rnn()
+        for m in self.memories:
+            if m["pre"].name == mem.name:
+                m["post"] = var
+                return
+        raise ValueError("unknown memory %r" % mem.name)
+
+    def step_output(self, o):
+        self._assert_in_rnn()
+        self.step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        from . import tensor as tensor_layers
+
+        parent = self.parent_block
+        prog = self.helper.main_program
+        assert prog.current_block().idx == parent.idx
+
+        for m in self.memories:
+            if m["post"] is None:
+                raise ValueError("memory never updated; call update_memory")
+
+        # time-major step inputs: [B,T,...] -> [T,B,...]
+        tm_inputs = []
+        for x, ipt in self.seq_inputs:
+            perm = [1, 0] + list(range(2, len(x.shape)))
+            tm = self._transpose(x, perm)
+            tm_inputs.append((tm, ipt))
+
+        outer_reads, _ = _block_reads_writes(self.sub_block)
+        bound = ({ipt.name for _, ipt in self.seq_inputs}
+                 | {m["pre"].name for m in self.memories})
+        closure_names = [n for n in outer_reads if n not in bound]
+
+        step_out_vars = []
+        for so in self.step_outputs:
+            v = parent.create_var(
+                name=unique_name(self.helper.name + "@out_tm"),
+                dtype=so.dtype)
+            step_out_vars.append(v)
+        final_mem_vars = [
+            parent.create_var(name=unique_name(self.helper.name + "@fmem"),
+                              dtype=m["boot"].dtype)
+            for m in self.memories]
+
+        parent.append_op(
+            type="recurrent",
+            inputs={
+                "StepInputs": [tm.name for tm, _ in tm_inputs],
+                "Boot": [m["boot"].name for m in self.memories],
+                "Closure": closure_names,
+            },
+            outputs={"StepOutputs": [v.name for v in step_out_vars],
+                     "FinalMems": [v.name for v in final_mem_vars]},
+            attrs={
+                "sub_block": BlockRef(self.sub_block.idx),
+                "step_input_names": [ipt.name for _, ipt in tm_inputs],
+                "closure_names": closure_names,
+                "mem_pre_names": [m["pre"].name for m in self.memories],
+                "mem_post_names": [m["post"].name for m in self.memories],
+                "step_output_names": [o.name for o in self.step_outputs],
+                "has_mask": False,
+            })
+
+        # back to batch-major
+        self.outputs = []
+        for v, so in zip(step_out_vars, self.step_outputs):
+            ndim = len(so.shape) + 1
+            perm = [1, 0] + list(range(2, ndim))
+            self.outputs.append(self._transpose(v, perm))
+        self.final_memories = final_mem_vars
+
+    def _transpose(self, x, perm):
+        helper = self.helper
+        out = helper.main_program.current_block().create_var(
+            name=unique_name(helper.name + "@t"), dtype=x.dtype)
+        helper.main_program.current_block().append_op(
+            type="transpose", inputs={"X": [x]}, outputs={"Out": [out]},
+            attrs={"axis": list(perm)})
+        return out
+
+    def __call__(self, *args, **kwargs):
+        if self.status != self.AFTER_RNN_BLOCK:
+            raise ValueError("rnn() must be called after the step block")
+        if len(self.outputs) == 1:
+            return self.outputs[0]
+        return self.outputs
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN over RaggedTensor (LoD) inputs.
+
+    reference: control_flow.py DynamicRNN:1252 — there it expands to
+    lod_rank_table + while + memory-shrinking; here ragged input is
+    padded to [B, maxT, D] with a mask and runs the same scan engine
+    with masked memory carries (states freeze past each sequence's end,
+    outputs ragged again).
+    """
+
+    def __init__(self, name=None):
+        StaticRNN.__init__(self, name=name)
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._ragged_like = None
+        self._mask_var = None
+
+    def block(self):
+        return self.step()
+
+    def step_input(self, x):
+        """x: RaggedTensor Variable (lod_level 1); returns per-step
+        [batch, D] view."""
+        self._assert_in_rnn()
+        if x.lod_level == 0:
+            return StaticRNN.step_input(self, x)
+        parent_prog = self.helper.main_program
+        cur = parent_prog.current_block_idx
+        parent_prog.current_block_idx = self.parent_block.idx
+        try:
+            padded, mask = _sequence_to_dense(self.helper, x)
+            if self._ragged_like is None:
+                self._ragged_like = x
+                self._mask_var = mask
+        finally:
+            parent_prog.current_block_idx = cur
+        ipt = self.sub_block.create_var(
+            name=unique_name("@".join([self.helper.name, "step_in"])),
+            dtype=x.dtype, shape=(-1,) + tuple(x.shape[1:]))
+        self.seq_inputs.append((padded, ipt))
+        return ipt
+
+    def _complete(self):
+        # same as StaticRNN but with the validity mask and ragged output
+        parent = self.parent_block
+
+        for m in self.memories:
+            if m["post"] is None:
+                raise ValueError("memory never updated; call update_memory")
+
+        tm_inputs = []
+        for x, ipt in self.seq_inputs:
+            perm = [1, 0] + list(range(2, len(x.shape)))
+            tm_inputs.append((self._transpose(x, perm), ipt))
+        mask_tm = None
+        if self._mask_var is not None:
+            mask_tm = self._transpose(self._mask_var, [1, 0])
+
+        outer_reads, _ = _block_reads_writes(self.sub_block)
+        bound = ({ipt.name for _, ipt in self.seq_inputs}
+                 | {m["pre"].name for m in self.memories})
+        closure_names = [n for n in outer_reads if n not in bound]
+
+        step_out_vars = [
+            parent.create_var(name=unique_name(self.helper.name + "@out_tm"),
+                              dtype=so.dtype)
+            for so in self.step_outputs]
+        final_mem_vars = [
+            parent.create_var(name=unique_name(self.helper.name + "@fmem"),
+                              dtype=m["boot"].dtype)
+            for m in self.memories]
+
+        inputs = {
+            "StepInputs": [tm.name for tm, _ in tm_inputs],
+            "Boot": [m["boot"].name for m in self.memories],
+            "Closure": closure_names,
+        }
+        if mask_tm is not None:
+            inputs["Mask"] = [mask_tm.name]
+        parent.append_op(
+            type="recurrent", inputs=inputs,
+            outputs={"StepOutputs": [v.name for v in step_out_vars],
+                     "FinalMems": [v.name for v in final_mem_vars]},
+            attrs={
+                "sub_block": BlockRef(self.sub_block.idx),
+                "step_input_names": [ipt.name for _, ipt in tm_inputs],
+                "closure_names": closure_names,
+                "mem_pre_names": [m["pre"].name for m in self.memories],
+                "mem_post_names": [m["post"].name for m in self.memories],
+                "step_output_names": [o.name for o in self.step_outputs],
+                "has_mask": mask_tm is not None,
+            })
+
+        self.outputs = []
+        for v, so in zip(step_out_vars, self.step_outputs):
+            ndim = len(so.shape) + 1
+            perm = [1, 0] + list(range(2, ndim))
+            bm = self._transpose(v, perm)          # [B, T, ...]
+            if self._ragged_like is not None:
+                rag = _dense_to_sequence(self.helper, bm,
+                                         self._ragged_like)
+                self.outputs.append(rag)
+            else:
+                self.outputs.append(bm)
+        self.final_memories = final_mem_vars
+
+
+def _sequence_to_dense(helper, x):
+    block = helper.main_program.current_block()
+    padded = block.create_var(name=unique_name(helper.name + "@padded"),
+                              dtype=x.dtype)
+    mask = block.create_var(name=unique_name(helper.name + "@mask"),
+                            dtype="float32")
+    mask.stop_gradient = True
+    block.append_op(
+        type="sequence_to_dense", inputs={"X": [x]},
+        outputs={"Out": [padded], "Mask": [mask]})
+    return padded, mask
+
+
+def _dense_to_sequence(helper, x, like):
+    block = helper.main_program.current_block()
+    out = block.create_var(name=unique_name(helper.name + "@ragged"),
+                           dtype=x.dtype, lod_level=like.lod_level)
+    block.append_op(
+        type="dense_to_sequence", inputs={"X": [x], "Like": [like]},
+        outputs={"Out": [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table layer plumbing (reference: control_flow.py
+# lod_rank_table:790s, lod_tensor_to_array, array_to_lod_tensor,
+# shrink_memory, reorder_lod_tensor_by_rank; ops in
+# ops/control_flow.py keep host semantics like the reference's CPU-only
+# kernels)
+# ---------------------------------------------------------------------------
+
+def lod_rank_table(x, level=0, **kwargs):
+    helper = LayerHelper("lod_rank_table", **kwargs)
+    table = helper.create_variable(
+        name=unique_name("lod_rank_table.tmp"), dtype="int32",
+        type=VarType.RAW, stop_gradient=True)
+    helper.append_op(type="lod_rank_table", inputs={"X": [x]},
+                     outputs={"Out": [table]},
+                     attrs={"level": level}, infer_shape=False)
+    return table
+
+
+def lod_tensor_to_array(x, table, **kwargs):
+    helper = LayerHelper("lod_tensor_to_array", **kwargs)
+    array = helper.create_variable(
+        name=unique_name("lod_tensor_to_array.tmp"), dtype=x.dtype,
+        type=VarType.TENSOR_ARRAY, stop_gradient=True)
+    helper.append_op(type="lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [array]}, infer_shape=False)
+    return array
+
+
+def array_to_lod_tensor(x, table, **kwargs):
+    helper = LayerHelper("array_to_lod_tensor", **kwargs)
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op(type="array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def shrink_memory(x, i, table, **kwargs):
+    helper = LayerHelper("shrink_memory", **kwargs)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, **kwargs):
+    helper = LayerHelper("reorder_lod_tensor_by_rank", **kwargs)
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op(type="reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def split_lod_tensor(input, mask, level=0, **kwargs):
+    helper = LayerHelper("split_lod_tensor", **kwargs)
+    out_true = helper.create_tmp_variable(dtype=input.dtype,
+                                          lod_level=input.lod_level)
+    out_false = helper.create_tmp_variable(dtype=input.dtype,
+                                           lod_level=input.lod_level)
+    helper.append_op(type="split_lod_tensor",
+                     inputs={"X": [input], "Mask": [mask]},
+                     outputs={"OutTrue": [out_true],
+                              "OutFalse": [out_false]},
+                     attrs={"level": level}, infer_shape=False)
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0, **kwargs):
+    helper = LayerHelper("merge_lod_tensor", **kwargs)
+    out = helper.create_tmp_variable(dtype=in_true.dtype,
+                                     lod_level=x.lod_level)
+    helper.append_op(type="merge_lod_tensor",
+                     inputs={"X": [x], "Mask": [mask],
+                             "InTrue": [in_true], "InFalse": [in_false]},
+                     outputs={"Out": [out]},
+                     attrs={"level": level}, infer_shape=False)
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both", **kwargs):
+    """reference: the print operator (print_op.cc) — debug-print a
+    tensor as it flows; forwards its input unchanged."""
+    helper = LayerHelper("print", **kwargs)
+    out = helper.create_tmp_variable(dtype=input.dtype,
+                                     lod_level=input.lod_level)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"first_n": first_n,
+                            "message": message or "",
+                            "summarize": summarize,
+                            "print_tensor_name": print_tensor_name,
+                            "print_phase": print_phase},
+                     infer_shape=False)
+    return out
+
+
+class IfElse:
+    """Row-routed two-branch execution (reference: control_flow.py
+    IfElse:~900 over split_lod_tensor / conditional blocks /
+    merge_lod_tensor): rows where cond holds flow through the
+    true_block, the rest through the false_block, outputs merge back in
+    input order."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.status = self.OUT_IF_ELSE_BLOCKS
+        self._true_inputs = {}
+        self._false_inputs = {}
+        self._true_outputs = []
+        self._false_outputs = []
+
+    def input(self, x):
+        if self.status == self.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside a block")
+        true_part, false_part = split_lod_tensor(x, self.cond)
+        self._true_inputs[x.name] = true_part
+        self._false_inputs[x.name] = false_part
+        return (true_part if self.status == self.IN_IF_ELSE_TRUE_BLOCKS
+                else false_part)
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self.status = self.IN_IF_ELSE_TRUE_BLOCKS
+        yield
+        self.status = self.OUT_IF_ELSE_BLOCKS
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self.status = self.IN_IF_ELSE_FALSE_BLOCKS
+        yield
+        self.status = self.OUT_IF_ELSE_BLOCKS
+
+    def output(self, *outs):
+        if self.status == self.IN_IF_ELSE_TRUE_BLOCKS:
+            self._true_outputs.extend(outs)
+        elif self.status == self.IN_IF_ELSE_FALSE_BLOCKS:
+            self._false_outputs.extend(outs)
+        else:
+            raise ValueError("output() must be called inside a block")
+
+    def __call__(self):
+        if len(self._true_outputs) != len(self._false_outputs):
+            raise ValueError("true/false blocks must produce the same "
+                             "number of outputs")
+        merged = []
+        # any split input serves as the row-order template
+        template = next(iter(self._true_inputs))
+        prog_var = self.helper.main_program.current_block().var(template)
+        for t, f in zip(self._true_outputs, self._false_outputs):
+            merged.append(merge_lod_tensor(t, f, prog_var, self.cond))
+        return merged if len(merged) > 1 else merged[0]
+
+
+class ParallelDo:
+    """API-compat data-parallel block (reference: control_flow.py
+    ParallelDo:230 over parallel_do_op.cc — splits the batch across
+    places and averages gradients via NCCL).  On TPU, batch-splitting
+    is expressed declaratively: the whole program runs SPMD over a
+    Mesh (paddle_tpu.parallel.ParallelTrainer shards the batch over
+    the 'dp' axis and XLA inserts the gradient psum over ICI), so this
+    wrapper executes its block once on the global batch — numerically
+    identical to the reference's split-and-average."""
+
+    def __init__(self, places, name=None):
+        self.places = places
+        self._ins = []
+        self._outs = []
+
+    @contextlib.contextmanager
+    def do(self):
+        yield
+
+    def read_input(self, var):
+        self._ins.append(var)
+        return var
+
+    def write_output(self, var):
+        self._outs.append(var)
+
+    def __call__(self):
+        return self._outs if len(self._outs) != 1 else self._outs[0]

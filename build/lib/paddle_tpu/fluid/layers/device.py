@@ -1,0 +1,24 @@
+"""Device layers (reference: python/paddle/v2/fluid/layers/device.py —
+get_places backed by get_places_op.cc)."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["get_places"]
+
+
+def get_places(device_count=None, device_type=None, **kwargs):
+    """Return the device list for data-parallel layout.  On TPU this is
+    informational — mesh construction (paddle_tpu.parallel.make_mesh) is
+    the real device layout; kept for API parity with parallel_do users."""
+    import jax
+
+    helper = LayerHelper("get_places", **kwargs)
+    out = helper.create_variable(name=helper.name, dtype="int32")
+    devices = jax.devices()
+    if device_count is None:
+        device_count = len(devices)
+    out.device_count = min(device_count, len(devices))
+    helper.append_op(type="get_places", outputs={"Out": [out]},
+                     attrs={"device_count": device_count,
+                            "device_type": device_type or "TPU"})
+    return out

@@ -1,0 +1,24 @@
+"""Data layers (reference: python/paddle/v2/fluid/layers/io.py)."""
+
+from ..layer_helper import LayerHelper
+from ..framework import default_main_program, default_startup_program
+from ...core.types import VarType
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=VarType.DENSE_TENSOR, stop_gradient=True, **kwargs):
+    """Declare a feed variable (reference: layers/io.py data).  With
+    append_batch_size the leading dim is dynamic (-1): the executor
+    re-specializes the compiled block per distinct feed shape, so readers
+    should produce fixed-size (or bucketed) batches to bound compilations.
+    """
+    helper = LayerHelper("data", name=name, **kwargs)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+
+    return helper.create_global_variable(
+        name=name, shape=shape, dtype=dtype, type=type,
+        stop_gradient=stop_gradient, lod_level=lod_level)

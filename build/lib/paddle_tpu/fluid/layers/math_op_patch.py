@@ -1,0 +1,80 @@
+"""Operator overloading on Variable (reference:
+python/paddle/v2/fluid/layers/math_op_patch.py)."""
+
+from ..framework import Variable, unique_name
+from ..layer_helper import LayerHelper
+
+__all__ = ["monkey_patch_variable"]
+
+
+def monkey_patch_variable():
+    def unique_tmp_name():
+        return unique_name("tmp")
+
+    def safe_get_dtype(var):
+        return var.dtype
+
+    def create_tensor(block, value, dtype, shape):
+        value = float(value)
+        tmp_name = unique_tmp_name()
+        var = block.create_var(name=tmp_name, shape=shape, dtype=dtype,
+                               stop_gradient=True)
+        block.append_op(
+            type="fill_constant", outputs={"Out": [var]},
+            attrs={"dtype": dtype, "shape": shape, "value": value})
+        return var
+
+    def create_scalar(block, value, dtype):
+        return create_tensor(block, value, dtype, shape=[1])
+
+    def astype(self, dtype):
+        block = self.block
+        out = block.create_var(name=unique_tmp_name(), dtype=dtype)
+        block.append_op(type="cast", inputs={"X": [self]},
+                        outputs={"Out": [out]},
+                        attrs={"in_dtype": self.dtype, "out_dtype": dtype})
+        return out
+
+    def _elemwise_method_creator_(method_name, op_type, reverse=False):
+        def __impl__(self, other_var):
+            block = self.block
+            dtype = safe_get_dtype(self)
+            if not isinstance(other_var, Variable):
+                other_var = create_scalar(block, value=other_var,
+                                          dtype=dtype)
+            lhs, rhs = self, other_var
+            if reverse:
+                lhs, rhs = rhs, lhs
+            out = block.create_var(name=unique_tmp_name(), dtype=dtype,
+                                   lod_level=self.lod_level)
+            block.append_op(
+                type=op_type, inputs={"X": [lhs], "Y": [rhs]},
+                outputs={"Out": [out]}, attrs={"axis": -1})
+            return out
+
+        __impl__.__name__ = method_name
+        return __impl__
+
+    for method, op_type, reverse in (
+            ("__add__", "elementwise_add", False),
+            ("__radd__", "elementwise_add", False),
+            ("__sub__", "elementwise_sub", False),
+            ("__rsub__", "elementwise_sub", True),
+            ("__mul__", "elementwise_mul", False),
+            ("__rmul__", "elementwise_mul", False),
+            ("__div__", "elementwise_div", False),
+            ("__truediv__", "elementwise_div", False),
+            ("__rdiv__", "elementwise_div", True),
+            ("__rtruediv__", "elementwise_div", True),
+            ("__pow__", "elementwise_pow", False),
+            ("__lt__", "less_than", False),
+            ("__le__", "less_equal", False),
+            ("__gt__", "greater_than", False),
+            ("__ge__", "greater_equal", False)):
+        setattr(Variable, method,
+                _elemwise_method_creator_(method, op_type, reverse))
+
+    Variable.astype = astype
+
+
+monkey_patch_variable()

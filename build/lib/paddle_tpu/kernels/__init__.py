@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference hand-writes CUDA for its hot paths (reference:
+paddle/cuda/src/hl_cuda_lstm.cu fused cells, paddle/operators/math/*.cu);
+here XLA fusion covers most of that, and pallas carries the kernels XLA
+can't schedule optimally — attention (online softmax) first.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

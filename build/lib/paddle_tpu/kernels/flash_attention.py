@@ -1,0 +1,199 @@
+"""Flash attention (pallas TPU kernel, online softmax).
+
+No reference counterpart (the 2018 snapshot predates flash attention;
+its attention is composed ops — reference: python/paddle/v2/fluid/
+nets.py:338 scaled_dot_product_attention materializes the full [T,T]
+probability matrix).  This kernel never materializes T×T in HBM: K/V
+stream through VMEM in blocks with running max/sum accumulation, the
+MXU sees [block_q, d] x [d, block_k] matmuls, and the backward pass
+recomputes probabilities blockwise (custom VJP).
+
+On CPU (tests) the same kernel runs under pallas interpret mode.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _needs_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, block_k,
+                sm_scale, causal, q_offset):
+    """One (batch*head, q_block) program: stream K/V blocks with online
+    softmax accumulation."""
+    q = q_ref[...] * sm_scale                    # [bq, d]
+    bq, d = q.shape
+    kt = k_ref[...]                              # [Tk, d]
+    vt = v_ref[...]                              # [Tk, d]
+    Tk = kt.shape[0]
+    q_idx = pl.program_id(1)
+
+    m = jnp.full((bq,), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    nblocks = Tk // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kt, i * block_k, block_k)
+        v_blk = jax.lax.dynamic_slice_in_dim(vt, i * block_k, block_k)
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = q_offset + q_idx * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p.astype(vt.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m, l, acc))
+    safe_l = jnp.where(l > 0, l, 1.0)
+    o_ref[...] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    m_ref[...] = m
+    l_ref[...] = l
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, q_offset):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    while Tq % bq:
+        bq //= 2
+    while Tk % bk:
+        bk //= 2
+    bq, bk = max(bq, 1), max(bk, 1)
+
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+
+    grid = (B * H, Tq // bq)
+    kernel = functools.partial(_fwd_kernel, block_k=bk, sm_scale=sm_scale,
+                               causal=causal, q_offset=q_offset)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((None, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
+        interpret=_needs_interpret(),
+    )(qf, kf, vf)
+    return (o.reshape(B, H, Tq, D), m.reshape(B, H, Tq),
+            l.reshape(B, H, Tq))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, sm_scale=None, causal=False, block_q=128,
+                    block_k=128, q_offset=0):
+    """softmax(q k^T * scale [+ causal mask]) v without materializing
+    the score matrix.  q,k,v: [B, H, T, D]; q_offset shifts the causal
+    diagonal (used by ring attention where q is a sequence shard)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    o, _, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, q_offset)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k,
+                    q_offset):
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    o, m, l = _fwd(q, k, v, sm_scale, causal, block_q, block_k, q_offset)
+    return o, (q, k, v, o, m, l)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, q_offset, res,
+                    do):
+    """Blockwise recompute backward (the standard flash-attention VJP):
+    dv = p^T do; dp = do v^T; ds = p*(dp - rowsum(do*o)); dq = ds k;
+    dk = ds^T q.  Runs as plain XLA over k-blocks via scan — the
+    recompute keeps memory at O(T*block) like the forward."""
+    q, k, v, o, m, l = res
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bk = min(block_k, Tk)
+    while Tk % bk:
+        bk //= 2
+    bk = max(bk, 1)
+
+    safe_l = jnp.where(l > 0, l, 1.0)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                           # [B,H,Tq]
+    qs = q.astype(jnp.float32) * sm_scale
+    q_pos = q_offset + jnp.arange(Tq)
+
+    def per_block(carry, i):
+        dq = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(k, i * bk, bk, axis=2)
+        v_blk = jax.lax.dynamic_slice_in_dim(v, i * bk, bk, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = i * bk + jnp.arange(bk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - m[..., None]) / safe_l[..., None]   # [B,H,q,k]
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p,
+                            do.astype(jnp.float32))
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do.astype(jnp.float32),
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])                    # [B,H,q,k]
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_blk.astype(jnp.float32)) * sm_scale
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qs)
+        return dq, (dk_blk, dv_blk)
+
+    nblocks = Tk // bk
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(per_block, dq0, jnp.arange(nblocks))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, Tk, D)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, Tk, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def reference_attention(q, k, v, sm_scale=None, causal=False, q_offset=0):
+    """Dense O(T^2)-memory attention for parity tests."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        mask = (q_offset + jnp.arange(Tq))[:, None] >= jnp.arange(Tk)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

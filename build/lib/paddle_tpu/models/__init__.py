@@ -1,0 +1,20 @@
+"""Model zoo: program-builder functions for the reference's benchmark and
+book-test model families (reference: benchmark/paddle/image/*.py,
+benchmark/paddle/rnn/rnn.py, python/paddle/v2/fluid/tests/book/*.py).
+
+Each builder appends ops to the current default program (use inside
+`fluid.program_guard`) and returns output Variables; nothing executes.
+"""
+
+from .image import (lenet5, mlp, smallnet_mnist_cifar, alexnet, vgg,
+                    vgg16, vgg19, resnet, resnet50, resnet101,
+                    resnet_cifar10, googlenet)
+from .text import (stacked_lstm_text_classifier, conv_text_classifier,
+                   word2vec_ngram, seq2seq)
+
+__all__ = [
+    "lenet5", "mlp", "smallnet_mnist_cifar", "alexnet", "vgg", "vgg16",
+    "vgg19", "resnet", "resnet50", "resnet101", "resnet_cifar10",
+    "googlenet", "stacked_lstm_text_classifier", "conv_text_classifier",
+    "word2vec_ngram", "seq2seq",
+]

@@ -1,0 +1,113 @@
+"""Dense (static-shape) autoregressive decoding under jit.
+
+The performance-path counterpart of the LoD beam ops (reference:
+beam_search_op.cc / beam_search_decode_op.cc and the v2
+RecurrentGradientMachine::beamSearch generation loop,
+RecurrentGradientMachine.h:307-309).  The reference's beam state is
+dynamic (ragged candidate lists); on TPU the state is dense
+[batch, beam] arrays scanned to max_len with lax.top_k — XLA compiles
+one executable, no host bookkeeping.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["greedy_decode", "beam_search_decode_dense"]
+
+NEG_INF = -1e30
+
+
+def greedy_decode(step_fn, init_state, bos, eos, max_len, batch_size):
+    """step_fn(state, tokens[B]) -> (logits [B,V], new_state).
+    Returns (tokens [B, max_len], lengths [B])."""
+
+    def body(carry, _):
+        state, tok, done = carry
+        logits, state = step_fn(state, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, eos, nxt)
+        done = done | (nxt == eos)
+        return (state, nxt, done), nxt
+
+    tok0 = jnp.full((batch_size,), bos, jnp.int32)
+    done0 = jnp.zeros((batch_size,), bool)
+    (_, _, done), toks = jax.lax.scan(body, (init_state, tok0, done0),
+                                      None, length=max_len)
+    toks = jnp.moveaxis(toks, 0, 1)               # [B, L]
+    lengths = jnp.argmax(toks == eos, axis=1) + 1
+    lengths = jnp.where(jnp.any(toks == eos, axis=1), lengths, max_len)
+    return toks, lengths
+
+
+def beam_search_decode_dense(step_fn, init_state, bos, eos, beam_size,
+                             max_len, batch_size,
+                             length_penalty=0.0):
+    """Batched beam search, fully jittable.
+
+    step_fn(state, tokens[N]) -> (logits [N,V], new_state) where N =
+    batch*beam and every state leaf is [N, ...].  Returns
+    (tokens [B, beam, max_len], scores [B, beam]) sorted best-first.
+    """
+    B, K = batch_size, beam_size
+
+    def expand(t):
+        return jnp.repeat(t, K, axis=0)
+
+    state = jax.tree_util.tree_map(expand, init_state)
+    tok = jnp.full((B * K,), bos, jnp.int32)
+    # only beam 0 alive at t=0 so the first top-k doesn't pick K copies
+    scores = jnp.tile(jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32),
+         jnp.full((K - 1,), NEG_INF, jnp.float32)]), (B,))
+    done = jnp.zeros((B * K,), bool)
+
+    def body(carry, _):
+        state, tok, scores, done = carry
+        logits, new_state = step_fn(state, tok)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # finished beams: only eos continues, at no cost
+        eos_only = jnp.full((V,), NEG_INF).at[eos].set(0.0)
+        logp = jnp.where(done[:, None], eos_only[None, :], logp)
+        total = scores[:, None] + logp                  # [B*K, V]
+        total = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(total, K)    # [B, K]
+        beam_idx = top_idx // V                          # within-batch beam
+        tok_idx = (top_idx % V).astype(jnp.int32)
+        flat_src = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+
+        state = jax.tree_util.tree_map(
+            lambda t: t[flat_src], new_state)
+        tok = tok_idx.reshape(-1)
+        scores = top_scores.reshape(-1)
+        done = done[flat_src] | (tok == eos)
+        return (state, tok, scores, done), (tok_idx, beam_idx)
+
+    (state, tok, scores, done), (toks, parents) = jax.lax.scan(
+        body, (state, tok, scores, done), None, length=max_len)
+
+    # backtrack through the per-step parent pointers (reference:
+    # beam_search_decode_op PackAllSteps backtracking)
+    def back(carry, step):
+        beam = carry                                   # [B, K]
+        tok_t, par_t = step
+        cur_tok = jnp.take_along_axis(tok_t, beam, axis=1)
+        prev_beam = jnp.take_along_axis(par_t, beam, axis=1)
+        return prev_beam, cur_tok
+
+    last_beam = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+    _, rev_toks = jax.lax.scan(back, last_beam, (toks, parents),
+                               reverse=True)
+    sequences = jnp.moveaxis(rev_toks, 0, 2)           # [B, K, L]
+    final_scores = scores.reshape(B, K)
+    if length_penalty:
+        lengths = jnp.sum(jnp.cumsum(sequences == eos, axis=2) == 0,
+                          axis=2) + 1
+        final_scores = final_scores / (lengths.astype(jnp.float32)
+                                       ** length_penalty)
+    order = jnp.argsort(-final_scores, axis=1)
+    sequences = jnp.take_along_axis(sequences, order[:, :, None], axis=1)
+    final_scores = jnp.take_along_axis(final_scores, order, axis=1)
+    return sequences, final_scores

@@ -1,0 +1,102 @@
+"""Text/sequence model zoo (program builders).
+
+TPU-native re-implementations of the reference RNN benchmark and book
+models (reference: benchmark/paddle/rnn/rnn.py,
+tests/book/test_understand_sentiment_*.py, tests/book/test_word2vec.py).
+Sequence inputs are RaggedTensors (the LoD equivalent) flowing through
+sequence_* ops.
+"""
+
+from ..fluid import layers, nets
+
+
+def stacked_lstm_text_classifier(data, dict_dim, class_dim=2,
+                                 emb_dim=128, hid_dim=128, stacked_num=2):
+    """Stacked-LSTM sentiment/text classifier (reference:
+    benchmark/paddle/rnn/rnn.py — emb + 2 lstm layers + pooled fc;
+    tests/book/test_understand_sentiment_dynamic_lstm.py stacked variant).
+
+    `data` is a ragged int64 sequence of word ids; returns softmax
+    probabilities [batch, class_dim].
+    """
+    emb = layers.embedding(input=data, size=[dict_dim, emb_dim])
+
+    fc1 = layers.fc(input=emb, size=hid_dim * 4)
+    lstm1, cell1 = layers.dynamic_lstm(input=fc1, size=hid_dim * 4)
+
+    inputs = [fc1, lstm1]
+    for _ in range(2, stacked_num + 1):
+        fc = layers.fc(input=inputs, size=hid_dim * 4)
+        lstm, cell = layers.dynamic_lstm(input=fc, size=hid_dim * 4,
+                                         is_reverse=False)
+        inputs = [fc, lstm]
+
+    fc_last = layers.sequence_pool(input=inputs[0], pool_type="max")
+    lstm_last = layers.sequence_pool(input=inputs[1], pool_type="max")
+    return layers.fc(input=[fc_last, lstm_last], size=class_dim,
+                     act="softmax")
+
+
+def conv_text_classifier(data, dict_dim, class_dim=2, emb_dim=128,
+                         hid_dim=128):
+    """Sequence-conv text classifier (reference:
+    tests/book/test_understand_sentiment_conv.py convolution_net)."""
+    emb = layers.embedding(input=data, size=[dict_dim, emb_dim])
+    conv_3 = nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                     filter_size=3, act="tanh",
+                                     pool_type="max")
+    conv_4 = nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                     filter_size=4, act="tanh",
+                                     pool_type="max")
+    return layers.fc(input=[conv_3, conv_4], size=class_dim, act="softmax")
+
+
+def seq2seq(src, trg_in, src_dict_size, trg_dict_size, emb_dim=32,
+            hidden_dim=32, encoder_depth=1):
+    """Encoder-decoder translation model, teacher-forced training path
+    (reference: tests/book/test_machine_translation.py — GRU/LSTM
+    encoder, DynamicRNN decoder seeded from the encoder's last state).
+
+    Returns per-step softmax over the target dictionary (ragged, aligned
+    with ``trg_in``).
+    """
+    src_emb = layers.embedding(input=src, size=[src_dict_size, emb_dim])
+    enc_proj = layers.fc(input=src_emb, size=hidden_dim * 4)
+    enc_hidden, _ = layers.dynamic_lstm(input=enc_proj,
+                                        size=hidden_dim * 4)
+    for _ in range(1, encoder_depth):
+        enc_proj = layers.fc(input=enc_hidden, size=hidden_dim * 4)
+        enc_hidden, _ = layers.dynamic_lstm(input=enc_proj,
+                                            size=hidden_dim * 4)
+    enc_last = layers.sequence_last_step(input=enc_hidden)  # [B, hid]
+
+    trg_emb = layers.embedding(input=trg_in, size=[trg_dict_size, emb_dim])
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        cur = rnn.step_input(trg_emb)
+        mem = rnn.memory(init=enc_last)
+        out = layers.fc(input=[cur, mem], size=hidden_dim, act="tanh")
+        prob = layers.fc(input=out, size=trg_dict_size, act="softmax")
+        rnn.update_memory(mem, out)
+        rnn.step_output(prob)
+    return rnn.outputs[0]
+
+
+def word2vec_ngram(words, dict_size, emb_dim=32, hidden_size=256,
+                   shared_embedding=True):
+    """N-gram neural language model (reference:
+    tests/book/test_word2vec.py — 4 context words predict the next).
+
+    `words` is a list of dense int64 Variables [batch, 1]; returns
+    softmax probabilities over the dictionary.
+    """
+    embs = []
+    shared_name = "shared_w" if shared_embedding else None
+    for i, w in enumerate(words):
+        attr = shared_name if shared_embedding else None
+        embs.append(layers.embedding(
+            input=w, size=[dict_size, emb_dim], param_attr=attr))
+    concat = layers.concat(input=embs, axis=1)
+    hidden = layers.fc(input=concat, size=hidden_size, act="sigmoid")
+    return layers.fc(input=hidden, size=dict_size, act="softmax")

@@ -29,42 +29,20 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 if not os.path.isdir(_NATIVE_DIR):
     # installed-wheel layout: sources land under
-    # <prefix>/paddle_tpu_native (data_files; --user installs put them
-    # under site.USER_BASE); build in a writable per-user cache keyed
-    # by package version so wheel upgrades rebuild fresh sources
-    import site as _site
+    # <prefix>/paddle_tpu_native (setup.py data_files); build in a
+    # writable per-user cache instead of the checkout
     import sys as _sys
 
-    def _wheel_version():
-        try:
-            from importlib.metadata import version
-
-            return version("paddle_tpu")
-        except Exception:
-            return "dev"
-
-    _roots = [_sys.prefix, getattr(_site, "USER_BASE", None) or ""]
-    _installed = next(
-        (p for p in (os.path.join(r, "paddle_tpu_native", "native")
-                     for r in _roots if r) if os.path.isdir(p)), None)
+    _installed = os.path.join(_sys.prefix, "paddle_tpu_native", "native")
     _cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME",
                        os.path.expanduser("~/.cache")),
-        "paddle_tpu", _wheel_version(), "native")
-    if _installed is not None and not os.path.isdir(_cache):
+        "paddle_tpu", "native")
+    if os.path.isdir(_installed) and not os.path.isdir(_cache):
         import shutil as _shutil
-        import tempfile as _tempfile
 
         os.makedirs(os.path.dirname(_cache), exist_ok=True)
-        # copy to a temp sibling then rename: atomic against concurrent
-        # imports, and an interrupted copy can't poison the cache
-        _tmp = _tempfile.mkdtemp(dir=os.path.dirname(_cache))
-        _shutil.copytree(_installed, os.path.join(_tmp, "native"))
-        try:
-            os.replace(os.path.join(_tmp, "native"), _cache)
-        except OSError:
-            pass  # a concurrent import won the rename; use its copy
-        _shutil.rmtree(_tmp, ignore_errors=True)
+        _shutil.copytree(_installed, _cache)
     if os.path.isdir(_cache):
         _NATIVE_DIR = _cache
 

@@ -1,0 +1,91 @@
+"""Activation op kernels.
+
+TPU-native equivalents of the reference activation catalogue
+(paddle/operators/activation_op.cc — the full list of 20+ unary
+activations, each with a hand-written CPU/CUDA functor pair).  Here each is
+one jnp expression; gradients come from jax.vjp via the generic grad path,
+replacing the reference's hand-derived grad functors.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+def _unary(name, fn, extra_attrs=()):
+    @register_op(name)
+    def kernel(ctx, ins, attrs, fn=fn):
+        xr = ins["X"][0]
+        x = xr.values if isinstance(xr, RaggedTensor) else xr
+        out = fn(x, attrs)
+        if isinstance(xr, RaggedTensor):
+            return {"Out": [xr.with_values(out)]}
+        return {"Out": [out]}
+    kernel.__name__ = name
+    return kernel
+
+
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_unary("softshrink", lambda x, a: jnp.where(
+    x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+    jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)))
+_unary("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("reciprocal", lambda x, a: 1.0 / x)
+_unary("log", lambda x, a: jnp.log(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_unary("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0),
+                                      a.get("t_max", 24.0)))
+_unary("leaky_relu", lambda x, a: jnp.where(
+    x >= 0, x, x * a.get("alpha", 0.02)))
+_unary("soft_relu", lambda x, a: jnp.log(
+    1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                         a.get("threshold", 40.0)))))
+_unary("elu", lambda x, a: jnp.where(
+    x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)))
+_unary("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_unary("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_unary("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+    a.get("scale_a", 2.0 / 3.0) * x))
+_unary("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, 0.0))
+_unary("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+
+
+@register_op("softmax")
+def softmax(ctx, ins, attrs):
+    # reference: operators/softmax_op.cc — softmax over the last dim of 2D
+    xr = ins["X"][0]
+    x = xr.values if isinstance(xr, RaggedTensor) else xr
+    if x.dtype == jnp.bfloat16:
+        # f32 exponentials; probabilities back in the activation dtype
+        out = jax.nn.softmax(x.astype(jnp.float32), axis=-1).astype(x.dtype)
+    else:
+        out = jax.nn.softmax(x, axis=-1)
+    if isinstance(xr, RaggedTensor):
+        return {"Out": [xr.with_values(out)]}
+    return {"Out": [out]}
+
+
+@register_op("prelu")
+def prelu(ctx, ins, attrs):
+    x = ins["X"][0]
+    alpha = ins["Alpha"][0]
+    return {"Out": [jnp.where(x >= 0, x, x * jnp.reshape(alpha, (1, -1))
+                              if alpha.size > 1 else x * alpha)]}

@@ -1,0 +1,73 @@
+"""MXU dtype policy helper for heavy-op kernels (see fluid/amp.py)."""
+
+import jax.numpy as jnp
+
+from ..utils import flags
+
+__all__ = ["mxu_operands", "acc_kwargs", "conv_acc_kwargs", "ACC_DTYPE",
+           "amp_result", "amp_harmonize", "keep_bf16_acts"]
+
+ACC_DTYPE = jnp.float32
+
+
+def acc_kwargs(*arrays):
+    """preferred_element_type kwargs for a matmul/conv over `arrays`:
+    force f32 accumulation only for bf16/f32 operands — integer and
+    f64 matmuls keep their native exact accumulation."""
+    if all(hasattr(a, "dtype") and
+           a.dtype in (jnp.bfloat16, jnp.float32) for a in arrays):
+        return {"preferred_element_type": ACC_DTYPE}
+    return {}
+
+
+def conv_acc_kwargs(*arrays):
+    """acc_kwargs for convolutions.  Unlike dot_general, whose transpose
+    rule casts for mixed dtypes, lax.conv_general_dilated's transpose
+    feeds the f32 cotangent of a preferred_element_type=f32 conv back
+    into a conv against the saved bf16 operand and rejects the mix.  So
+    bf16 convs stay uniform-bf16 end to end (forward and both transpose
+    convs); the MXU accumulates bf16 convs in f32 internally regardless,
+    only the output rounds to bf16."""
+    if any(hasattr(a, "dtype") and a.dtype == jnp.bfloat16 for a in arrays):
+        return {}
+    return acc_kwargs(*arrays)
+
+
+def keep_bf16_acts():
+    return (flags.get_flag("amp_bf16") and flags.get_flag("amp_bf16_act"))
+
+
+def amp_result(out, ref_dtype):
+    """Cast a heavy-op result to its reference dtype — unless the
+    bf16-activation policy is on, in which case an f32-reference result
+    stays (or becomes) bf16 so the downstream elementwise/norm chain
+    reads and writes half the bytes.  Statistics, losses, and master
+    weights never come through here."""
+    if keep_bf16_acts() and ref_dtype == jnp.float32:
+        return out if out.dtype == jnp.bfloat16 else out.astype(jnp.bfloat16)
+    return out.astype(ref_dtype)
+
+
+def amp_harmonize(x, y):
+    """Under the bf16-activation policy, a binary elementwise op over a
+    (bf16 activation, f32 side-input) pair computes in bf16 — without
+    this, jnp promotion re-materializes the full activation in f32
+    (e.g. the conv bias-add against an f32 bias parameter)."""
+    if not keep_bf16_acts():
+        return x, y
+    if x.dtype == jnp.bfloat16 and y.dtype == jnp.float32:
+        return x, y.astype(jnp.bfloat16)
+    if x.dtype == jnp.float32 and y.dtype == jnp.bfloat16:
+        return x.astype(jnp.bfloat16), y
+    return x, y
+
+
+def mxu_operands(*arrays):
+    """Under FLAGS_amp_bf16, cast f32 matmul/conv operands to bf16 (the
+    MXU's fast dtype); accumulation stays f32 via
+    preferred_element_type at the call site."""
+    if not flags.get_flag("amp_bf16"):
+        return arrays
+    return tuple(a.astype(jnp.bfloat16)
+                 if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                 for a in arrays)

@@ -1,0 +1,180 @@
+"""Beam search ops (generation path).
+
+TPU-native equivalents of the reference beam search pair
+(reference: paddle/operators/beam_search_op.cc — per-source top-k over
+candidate (prefix, token) pairs with end-id pruning;
+beam_search_decode_op.cc — backtrack the per-step selections into full
+hypotheses).
+
+Both are host ops (jittable=False): the reference registers them CPU-only
+as well (no .cu kernels) — beam bookkeeping is dynamic-shaped by nature.
+A fully-on-TPU static-shape beam decode (dense [batch, beam] state with
+lax.top_k inside lax.while_loop) is provided separately in
+paddle_tpu.models.decode for the performance path; these ops keep the
+reference's LoD program semantics for program parity.
+
+LoD convention (reference beam_search_op.h:46-63): selected_ids/scores
+are [M, 1] with two split levels: level 0 = source sentences over beam
+rows, level 1 = one segment per input beam row (its surviving items).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+def _splits_of(rt, level):
+    return np.asarray(rt.row_splits[level]).astype(np.int64)
+
+
+@register_op("beam_search", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("pre_ids", "ids", "scores"))
+def beam_search(ctx, ins, attrs):
+    pre_ids_t = ins["pre_ids"][0]
+    ids_t = ins["ids"][0]
+    scores_t = ins["scores"][0]
+    level = int(attrs.get("level", 0))
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs.get("end_id", 0))
+
+    scores = np.asarray(scores_t.values if isinstance(scores_t,
+                                                      RaggedTensor)
+                        else scores_t)
+    n_rows = scores.shape[0]
+    scores = scores.reshape(n_rows, -1)
+    ids = np.asarray(ids_t.values if isinstance(ids_t, RaggedTensor)
+                     else ids_t).reshape(n_rows, -1).astype(np.int64)
+    pre_ids = np.asarray(pre_ids_t.values if isinstance(
+        pre_ids_t, RaggedTensor) else pre_ids_t).reshape(-1).astype(
+            np.int64)
+    if isinstance(scores_t, RaggedTensor):
+        high = _splits_of(scores_t, level)
+    elif isinstance(ids_t, RaggedTensor):
+        high = _splits_of(ids_t, level)
+    else:
+        high = np.asarray([0, n_rows], np.int64)  # one source
+    # per-source top-beam_size over all (row, candidate) items
+    # (reference: SelectTopBeamSizeItems)
+    selected_per_row = [[] for _ in range(n_rows)]
+    for s in range(len(high) - 1):
+        items = []
+        for r in range(int(high[s]), int(high[s + 1])):
+            for j in range(ids.shape[1]):
+                items.append((r, int(ids[r, j]), float(scores[r, j])))
+        items.sort(key=lambda it: -it[2])
+        for r, tok, sc in items[:beam_size]:
+            selected_per_row[r].append((tok, sc))
+
+    # prune rows whose prefix already ended (reference:
+    # PruneEndidCandidates)
+    for r in range(min(n_rows, len(pre_ids))):
+        if pre_ids[r] == end_id:
+            selected_per_row[r] = []
+
+    out_ids, out_scores = [], []
+    low = [0]
+    for r in range(n_rows):
+        row_items = sorted(selected_per_row[r], key=lambda it: it[0])
+        for tok, sc in row_items:
+            out_ids.append(tok)
+            out_scores.append(sc)
+        low.append(len(out_ids))
+    low = np.asarray(low, np.int64)
+
+    sel_ids = np.asarray(out_ids, np.int64).reshape(-1, 1)
+    sel_scores = np.asarray(out_scores, np.float32).reshape(-1, 1)
+    if sel_ids.size == 0:
+        sel_ids = np.zeros((0, 1), np.int64)
+        sel_scores = np.zeros((0, 1), np.float32)
+    return {
+        "selected_ids": [RaggedTensor(jnp.asarray(sel_ids), [high, low])],
+        "selected_scores": [RaggedTensor(jnp.asarray(sel_scores),
+                                         [high, low])],
+    }
+
+
+@register_op("beam_search_decode", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Ids", "Scores"))
+def beam_search_decode(ctx, ins, attrs):
+    """Backtrack per-step beam selections into hypotheses.
+
+    Ids/Scores: host lists of the per-step selected_ids/selected_scores
+    RaggedTensors (2-level splits as produced by beam_search).  Outputs
+    SentenceIds/SentenceScores: [Ntok, 1] with 2-level splits
+    (source -> hypothesis -> tokens), mirroring reference
+    beam_search_decode_op.h PackAllSteps.
+    """
+    steps_ids = ins["Ids"]
+    steps_scores = ins["Scores"]
+    if len(steps_ids) == 1 and isinstance(steps_ids[0], (list, tuple)):
+        steps_ids = list(steps_ids[0])
+        steps_scores = list(steps_scores[0])
+    n_steps = len(steps_ids)
+    assert n_steps > 0, "beam_search_decode needs at least one step"
+
+    ids_np = [np.asarray(t.values).reshape(-1) for t in steps_ids]
+    scores_np = [np.asarray(t.values).reshape(-1) for t in steps_scores]
+    lod0 = [np.asarray(t.row_splits[0]) for t in steps_ids]
+    lod1 = [np.asarray(t.row_splits[1]) for t in steps_ids]
+    n_src = len(lod0[0]) - 1
+
+    # parent of item m at step t = index j of the level-1 segment
+    # containing m; that j is the item index at step t-1.
+    parents = []
+    for t in range(n_steps):
+        par = np.searchsorted(lod1[t], np.arange(len(ids_np[t])),
+                              side="right") - 1
+        parents.append(par)
+
+    def source_of(t, item):
+        row = parents[t][item] if t >= 0 else item
+        # level-1 segment j corresponds to beam row j; level 0 maps rows
+        # to sources
+        return int(np.searchsorted(lod0[t], row, side="right") - 1)
+
+    # an item is a leaf if no item at step t+1 has it as parent
+    sentences = [[] for _ in range(n_src)]  # per source: (ids, scores)
+    for t in range(n_steps):
+        if t + 1 < n_steps:
+            has_kid = np.zeros(len(ids_np[t]), bool)
+            kids = parents[t + 1]
+            has_kid[kids[kids < len(has_kid)]] = True
+        else:
+            has_kid = np.zeros(len(ids_np[t]), bool)
+        for m in range(len(ids_np[t])):
+            if has_kid[m]:
+                continue
+            # backtrack to the root
+            toks, scs = [], []
+            tt, mm = t, m
+            while tt >= 0:
+                toks.append(int(ids_np[tt][mm]))
+                scs.append(float(scores_np[tt][mm]))
+                mm = int(parents[tt][mm])
+                tt -= 1
+            toks.reverse()
+            scs.reverse()
+            sentences[source_of(t, m)].append((toks, scs))
+
+    out_ids, out_scores = [], []
+    l0, l1 = [0], [0]
+    for s in range(n_src):
+        for toks, scs in sentences[s]:
+            out_ids.extend(toks)
+            out_scores.extend(scs)
+            l1.append(len(out_ids))
+        l0.append(len(l1) - 1)
+    sent_ids = np.asarray(out_ids, np.int64).reshape(-1, 1)
+    sent_scores = np.asarray(out_scores, np.float32).reshape(-1, 1)
+    if sent_ids.size == 0:
+        sent_ids = np.zeros((0, 1), np.int64)
+        sent_scores = np.zeros((0, 1), np.float32)
+    l0 = np.asarray(l0, np.int64)
+    l1 = np.asarray(l1, np.int64)
+    return {
+        "SentenceIds": [RaggedTensor(jnp.asarray(sent_ids), [l0, l1])],
+        "SentenceScores": [RaggedTensor(jnp.asarray(sent_scores),
+                                        [l0, l1])],
+    }

@@ -1,0 +1,603 @@
+"""Control-flow ops: while / conditional_block / recurrent / tensor arrays.
+
+TPU-native re-design of the reference's interpreted control flow:
+  * while_op.cc:35 runs its sub-block via a nested Executor per iteration;
+    here the sub-block is *lowered in-trace* into lax.while_loop (unbounded,
+    non-differentiable — generation/decode) or lax.scan with an active-mask
+    (attrs["max_steps"] set — bounded, reverse-differentiable), so XLA
+    compiles the whole loop.
+  * conditional_block_op.cc -> lax.cond over an env-carry.
+  * recurrent_op.cc (the StaticRNN engine, + RecurrentGradientMachine's
+    per-timestep expansion) -> one lax.scan over time-major step inputs
+    with memory carries and optional per-step mask (variable-length
+    sequences; replaces the reference's dynamic graph expansion).
+  * tensor_array_read_write_op.cc / lod_array_length_op.cc over the dense
+    fixed-capacity TensorArray (core/tensor_array.py).
+
+Grad strategy: recurrent and bounded-while differentiate through the
+generic jax.vjp path (registry.run_generic_grad) — XLA's scan transpose
+replaces the reference's hand-built sub-block backward
+(backward.cc:415 MakeBlockBackward, while_op.cc:93 WhileGradOp).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, register_grad_kernel
+from ..core.tensor_array import TensorArray, EmptyTensorArray, \
+    DEFAULT_CAPACITY
+
+
+def _sub_ctx(ctx, block_idx, env):
+    from ..fluid.executor import ExecContext
+
+    return ExecContext(None, ctx.program, block_idx, env, rng=None)
+
+
+def _run_block(ctx, block_idx, env):
+    from ..fluid.executor import apply_op
+
+    sub = _sub_ctx(ctx, block_idx, env)
+    block_desc = ctx.program.desc.block(block_idx)
+    for od in block_desc.ops:
+        apply_op(sub, od)
+    return env
+
+
+def _scalar_bool(v):
+    return jnp.asarray(v).reshape(()).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+@register_op("while", nondiff_inputs=("Condition",))
+def while_op(ctx, ins, attrs):
+    """reference: while_op.cc:35.  attrs:
+      sub_block: BlockRef; x_names: names for ins["X"] (closure + carried
+      initial values); carry_names: loop-state var names (written in the
+      block; must exist among x_names); cond_name: condition var name;
+      max_steps: if set, lower to scan (differentiable, bounded)."""
+    blk = attrs["sub_block"].idx
+    x_names = list(attrs["x_names"])
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    max_steps = attrs.get("max_steps")
+
+    closure = dict(zip(x_names, ins["X"]))
+    missing = [n for n in carry_names if n not in closure]
+    if missing:
+        raise RuntimeError(
+            "while: loop vars %s have no initial value before the loop "
+            "(initialize them — e.g. first array_write — outside)" % missing)
+    init = {n: closure[n] for n in carry_names}
+    for a in init.values():
+        if isinstance(a, EmptyTensorArray):
+            raise RuntimeError(
+                "while: a TensorArray carried through the loop must be "
+                "written once before the loop (static shapes)")
+
+    def body_env(carry):
+        env = dict(closure)
+        env.update(carry)
+        _run_block(ctx, blk, env)
+        return {n: env[n] for n in carry_names}
+
+    if max_steps is None:
+        final = lax.while_loop(
+            lambda c: _scalar_bool(c[cond_name]), body_env, init)
+    else:
+        def scan_body(carry, _):
+            active = _scalar_bool(carry[cond_name])
+            new = body_env(carry)
+            merged = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(active, a, b), new, carry)
+            return merged, None
+
+        final, _ = lax.scan(scan_body, init, None, length=int(max_steps))
+
+    return {"Out": [final[n] for n in carry_names]}
+
+
+def _while_infer_shape(block, op_desc):
+    # loop vars keep their pre-loop meta (same names in and out)
+    return None
+
+
+from .registry import get_op_info as _gi
+
+_gi("while").infer_shape = _while_infer_shape
+
+
+# ---------------------------------------------------------------------------
+# conditional_block
+# ---------------------------------------------------------------------------
+
+@register_op("conditional_block", nondiff_inputs=("Cond",))
+def conditional_block(ctx, ins, attrs):
+    """reference: conditional_block_op.cc.  Runs the sub-block iff the
+    scalar condition holds; written vars fall back to their outer values
+    (which must exist) when it doesn't.  attrs: sub_block, x_names,
+    out_names, is_scalar_condition."""
+    blk = attrs["sub_block"].idx
+    x_names = list(attrs["x_names"])
+    out_names = list(attrs["out_names"])
+    cond = ins["Cond"][0]
+    if attrs.get("is_scalar_condition", True):
+        pred = _scalar_bool(cond)
+    else:
+        pred = jnp.asarray(cond).any()
+
+    closure = dict(zip(x_names, ins["X"]))
+    missing = [n for n in out_names if n not in closure]
+    if missing:
+        raise RuntimeError(
+            "conditional_block: outputs %s need outer initial values "
+            "(the false branch keeps them)" % missing)
+
+    def true_fn(cl):
+        env = dict(cl)
+        _run_block(ctx, blk, env)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(cl):
+        return tuple(cl[n] for n in out_names)
+
+    outs = lax.cond(pred, true_fn, false_fn, closure)
+    return {"Out": list(outs)}
+
+
+_gi("conditional_block").infer_shape = lambda block, od: None
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN / DynamicRNN engine)
+# ---------------------------------------------------------------------------
+
+@register_op("recurrent")
+def recurrent(ctx, ins, attrs):
+    """One scan over time.  reference: recurrent_op.cc (StaticRNN) and
+    RecurrentGradientMachine.h:32 (dynamic per-timestep expansion) — both
+    become a single lax.scan with masked memory carries.
+
+    inputs:
+      StepInputs: time-major [T, B, ...] tensors, one per step-input name
+      Boot: initial memory values, one per memory
+      Closure: external reads (weights etc.)
+      Mask: optional [T, B] float/bool validity mask
+    attrs:
+      sub_block; step_input_names; closure_names;
+      mem_pre_names / mem_post_names (parallel lists);
+      step_output_names; has_mask
+    outputs:
+      StepOutputs: stacked [T, B, ...] per step-output (masked rows zero)
+      FinalMems: memory values after each sequence's last valid step
+    """
+    blk = attrs["sub_block"].idx
+    step_in_names = list(attrs["step_input_names"])
+    closure_names = list(attrs["closure_names"])
+    pre_names = list(attrs["mem_pre_names"])
+    post_names = list(attrs["mem_post_names"])
+    out_names = list(attrs["step_output_names"])
+    has_mask = bool(attrs.get("has_mask", False))
+
+    xs = list(ins.get("StepInputs", []))
+    boots = list(ins.get("Boot", []))
+    closure = dict(zip(closure_names, ins.get("Closure", [])))
+    mask = ins["Mask"][0] if has_mask else None
+
+    def body(mems, xt):
+        xs_t = xt[:-1] if has_mask else xt
+        m_t = xt[-1] if has_mask else None
+        env = dict(closure)
+        for n, v in zip(step_in_names, xs_t):
+            env[n] = v
+        for n, v in zip(pre_names, mems):
+            env[n] = v
+        _run_block(ctx, blk, env)
+        new_mems = [env[n] for n in post_names]
+        outs_t = [env[n] for n in out_names]
+        if m_t is not None:
+            def keep(new, old):
+                m = m_t.astype(bool).reshape(
+                    m_t.shape + (1,) * (new.ndim - m_t.ndim))
+                return jnp.where(m, new, old)
+
+            new_mems = [keep(n_, o_) for n_, o_ in zip(new_mems, mems)]
+            outs_t = [
+                jnp.where(
+                    m_t.astype(bool).reshape(
+                        m_t.shape + (1,) * (o.ndim - m_t.ndim)),
+                    o, jnp.zeros_like(o))
+                for o in outs_t]
+        return tuple(new_mems), tuple(outs_t)
+
+    scan_xs = tuple(xs) + ((mask,) if has_mask else ())
+    final_mems, step_outs = lax.scan(body, tuple(boots), scan_xs)
+    return {"StepOutputs": list(step_outs), "FinalMems": list(final_mems)}
+
+
+def _recurrent_infer_shape(block, op_desc):
+    from ..fluid.framework import _find_var_desc
+
+    T = None
+    for n in op_desc.input("StepInputs"):
+        vd = _find_var_desc(block, n)
+        T = vd.shape[0] if vd.shape else None
+        break
+    for slot_in, slot_out in (("Boot", "FinalMems"),):
+        for bn, on in zip(op_desc.input(slot_in), op_desc.output(slot_out)):
+            src = _find_var_desc(block, bn)
+            dst = _find_var_desc(block, on)
+            dst.shape, dst.dtype, dst.lod_level = src.shape, src.dtype, 0
+    # step outputs: [T] + sub-block var meta
+    prog = block.program
+    sub_idx = op_desc.attrs["sub_block"].idx
+    sub_bd = prog.desc.block(sub_idx)
+    for name, out_n in zip(op_desc.attrs["step_output_names"],
+                           op_desc.output("StepOutputs")):
+        dst = _find_var_desc(block, out_n)
+        if name in sub_bd.vars:
+            sv = sub_bd.vars[name]
+            dst.shape = (T if T is not None else -1,) + tuple(sv.shape or ())
+            dst.dtype = sv.dtype
+            dst.lod_level = 0
+
+
+_gi("recurrent").infer_shape = _recurrent_infer_shape
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (reference: tensor_array_read_write_op.cc,
+# lod_array_length_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("write_to_array", nondiff_inputs=("I",))
+def write_to_array(ctx, ins, attrs):
+    x = ins["X"][0]
+    i = ins["I"][0]
+    arr = ins.get("Array", [None])[0]
+    if arr is None:
+        arr = EmptyTensorArray(attrs.get("capacity", DEFAULT_CAPACITY))
+    return {"Out": [arr.write(i, x)]}
+
+
+@register_op("read_from_array", nondiff_inputs=("I",))
+def read_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    i = ins["I"][0]
+    if isinstance(arr, EmptyTensorArray):
+        raise RuntimeError("read_from_array on an empty TensorArray")
+    return {"Out": [arr.read(i)]}
+
+
+@register_op("lod_array_length", stop_gradient_op=True)
+def lod_array_length(ctx, ins, attrs):
+    arr = ins["X"][0]
+    if isinstance(arr, EmptyTensorArray):
+        return {"Out": [jnp.zeros((1,), jnp.int64)]}
+    return {"Out": [arr.length.reshape((1,)).astype(jnp.int64)]}
+
+
+@register_op("max_sequence_len", stop_gradient_op=True, jittable=False)
+def max_sequence_len(ctx, ins, attrs):
+    """reference: max_sequence_len_op.cc — max length from a
+    LoDRankTable (host object) or directly from a RaggedTensor."""
+    rt = ins["RankTable"][0]
+    if hasattr(rt, "max_len"):          # LoDRankTable
+        return {"Out": [jnp.asarray([rt.max_len()], jnp.int64)]}
+    lens = rt.seq_lengths() if hasattr(rt, "seq_lengths") else rt
+    return {"Out": [jnp.max(lens).reshape((1,)).astype(jnp.int64)]}
+
+
+def _array_infer_shape(block, op_desc):
+    return None
+
+
+for _t in ("write_to_array", "read_from_array", "lod_array_length",
+           "max_sequence_len"):
+    _gi(_t).infer_shape = _array_infer_shape
+
+
+@register_op("get_places", stop_gradient_op=True, jittable=False)
+def get_places(ctx, ins, attrs):
+    """reference: get_places_op.cc — device enumeration for parallel_do;
+    on TPU informational only (the Mesh owns layout)."""
+    import jax
+
+    n = attrs.get("device_count") or 0
+    avail = len(jax.devices())
+    n = avail if n <= 0 else min(n, avail)
+    return {"Out": [jnp.arange(n, dtype=jnp.int32)]}
+
+
+_gi("get_places").infer_shape = lambda block, od: None
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table machinery (the reference DynamicRNN plumbing:
+# lod_rank_table_op.cc, lod_tensor_to_array_op.cc,
+# array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+# reorder_lod_tensor_by_rank_op.cc, split_lod_tensor_op.cc,
+# merge_lod_tensor_op.cc).  Host ops — the reference computes all of
+# this on CPU as well; the scan-based DynamicRNN (fluid.layers) is the
+# compiled fast path.
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from ..core.ragged import RaggedTensor
+from ..core.rank_table import LoDRankTable
+
+
+@register_op("lod_rank_table", stop_gradient_op=True, jittable=False)
+def lod_rank_table(ctx, ins, attrs):
+    """reference: lod_rank_table_op.cc — sort level-`level` sequences by
+    length descending.  For a nested (lod_level-2) input at level 0 the
+    "length" of an outer sequence is its subsequence count, matching the
+    reference's nested DynamicRNN semantics
+    (RecurrentGradientMachine.h:32): each RNN step then consumes one
+    whole subsequence per active outer sequence."""
+    x = ins["X"][0]
+    level = int(attrs.get("level", 0))
+    if not 0 <= level < x.lod_level:
+        raise ValueError(
+            "lod_rank_table level %d out of range for lod_level %d"
+            % (level, x.lod_level))
+    if x.lod_level > 2:
+        # the downstream array kernels slice exactly two levels; fail
+        # loudly rather than mix levels silently
+        raise NotImplementedError(
+            "rank-table machinery supports lod_level 1 and 2 inputs "
+            "(got %d)" % x.lod_level)
+    lengths = np.asarray(x.seq_lengths(level)).tolist()
+    return {"Out": [LoDRankTable.from_lengths(lengths)]}
+
+
+def _outer_item_bounds(x, i):
+    """Row range [begin, end) of outer sequence `i`'s values, resolving
+    through all deeper split levels."""
+    begin, end = i, i + 1
+    for rs in x.row_splits:
+        rs = np.asarray(rs)
+        begin, end = int(rs[begin]), int(rs[end])
+    return begin, end
+
+
+@register_op("reorder_lod_tensor_by_rank", stop_gradient_op=True,
+             jittable=False)
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """reference: reorder_lod_tensor_by_rank_op.cc — permute X's
+    level-0 sequences into the rank table's order; deeper LoD levels
+    travel with their outer sequence."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    vals = np.asarray(x.values)
+    n_levels = len(x.row_splits)
+    if n_levels > 2:
+        raise NotImplementedError(
+            "reorder_lod_tensor_by_rank supports lod_level 1 and 2 "
+            "inputs (got %d)" % n_levels)
+    out_rows = []
+    # per-level lengths of the permuted sequences
+    level_lengths = [[] for _ in range(n_levels)]
+    inner = np.asarray(x.row_splits[-1])
+    outer = np.asarray(x.row_splits[0])
+    for i in table.indices():
+        b, e = _outer_item_bounds(x, i)
+        out_rows.append(vals[b:e])
+        level_lengths[0].append(
+            int(outer[i + 1]) - int(outer[i]))
+        if n_levels == 2:
+            level_lengths[1].extend(
+                int(inner[j + 1]) - int(inner[j])
+                for j in range(int(outer[i]), int(outer[i + 1])))
+    out = np.concatenate(out_rows, 0) if out_rows else vals[:0]
+    splits = [np.cumsum([0] + ls).astype(np.int32)
+              for ls in level_lengths]
+    return {"Out": [RaggedTensor(jnp.asarray(out), splits)]}
+
+
+@register_op("lod_tensor_to_array", stop_gradient_op=True, jittable=False)
+def lod_tensor_to_array(ctx, ins, attrs):
+    """reference: lod_tensor_to_array_op.cc — per-timestep slices in
+    rank-table order.  lod_level-1 input: step t is a dense batch of
+    the t-th element of every still-active sequence.  lod_level-2
+    input: step t is a lod_level-1 RaggedTensor holding the t-th
+    SUBSEQUENCE of every still-active outer sequence (the reference's
+    nested-sequence step unit)."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    if x.lod_level > 2:
+        raise NotImplementedError(
+            "lod_tensor_to_array supports lod_level 1 and 2 inputs "
+            "(got %d)" % x.lod_level)
+    vals = np.asarray(x.values)
+    steps = []
+    if x.lod_level <= 1:
+        splits = np.asarray(x.row_splits[-1])
+        for t in range(table.max_len()):
+            rows = [vals[splits[i] + t]
+                    for i, n in table.items if n > t]
+            steps.append(jnp.asarray(np.stack(rows, 0)))
+        return {"Out": [steps]}
+
+    outer = np.asarray(x.row_splits[0])
+    inner = np.asarray(x.row_splits[1])
+    for t in range(table.max_len()):
+        rows, lengths = [], []
+        for i, n in table.items:
+            if n <= t:
+                continue
+            sub = int(outer[i]) + t
+            b, e = int(inner[sub]), int(inner[sub + 1])
+            rows.append(vals[b:e])
+            lengths.append(e - b)
+        step_vals = np.concatenate(rows, 0) if rows else vals[:0]
+        steps.append(RaggedTensor(
+            jnp.asarray(step_vals),
+            [np.cumsum([0] + lengths).astype(np.int32)]))
+    return {"Out": [steps]}
+
+
+@register_op("array_to_lod_tensor", stop_gradient_op=True, jittable=False)
+def array_to_lod_tensor(ctx, ins, attrs):
+    """reference: array_to_lod_tensor_op.cc — inverse of
+    lod_tensor_to_array (both the dense-step and the nested
+    ragged-step forms)."""
+    steps = ins["X"][0]
+    table = ins["RankTable"][0]
+    nested = any(isinstance(s, RaggedTensor) for s in steps)
+    seqs = {i: [] for i, _ in table.items}       # per outer seq, per t
+    sub_lengths = {i: [] for i, _ in table.items}
+    for t, arr in enumerate(steps):
+        if nested:
+            svals = np.asarray(arr.values)
+            ssplits = np.asarray(arr.row_splits[-1])
+            pos = 0
+            for i, n in table.items:
+                if n > t:
+                    b, e = int(ssplits[pos]), int(ssplits[pos + 1])
+                    seqs[i].append(svals[b:e])
+                    sub_lengths[i].append(e - b)
+                    pos += 1
+        else:
+            arr = np.asarray(arr)
+            row = 0
+            for i, n in table.items:
+                if n > t:
+                    seqs[i].append(arr[row])
+                    row += 1
+    # output stays in rank-table order (the reference's RNN in/out
+    # convention: reorder_lod_tensor_by_rank restores original order)
+    if nested:
+        out_rows, outer_lengths, inner_lengths = [], [], []
+        for i, n in table.items:
+            out_rows.extend(seqs[i])
+            outer_lengths.append(n)
+            inner_lengths.extend(sub_lengths[i])
+        out = (np.concatenate(out_rows, 0) if out_rows
+               else np.asarray(steps[0].values)[:0])
+        return {"Out": [RaggedTensor(
+            jnp.asarray(out),
+            [np.cumsum([0] + outer_lengths).astype(np.int32),
+             np.cumsum([0] + inner_lengths).astype(np.int32)])]}
+    out_rows, new_splits = [], [0]
+    for i, n in table.items:
+        out_rows.extend(seqs[i])
+        new_splits.append(new_splits[-1] + n)
+    out = np.stack(out_rows, 0)
+    return {"Out": [RaggedTensor(jnp.asarray(out),
+                                 [np.asarray(new_splits, np.int32)])]}
+
+
+@register_op("shrink_rnn_memory", jittable=False,
+             nondiff_inputs=("RankTable", "I"))
+def shrink_rnn_memory(ctx, ins, attrs):
+    """reference: shrink_rnn_memory_op.cc — keep the prefix of rows
+    still active at step I (X is a dense [B, ...] memory)."""
+    x = ins["X"][0]
+    if isinstance(x, RaggedTensor):
+        raise TypeError("shrink_rnn_memory expects a dense memory "
+                        "tensor, not a RaggedTensor")
+    x = np.asarray(x)
+    table = ins["RankTable"][0]
+    i = int(np.asarray(ins["I"][0]).reshape(-1)[0])
+    return {"Out": [jnp.asarray(x[:table.active_at(i)])]}
+
+
+@register_grad_kernel("shrink_rnn_memory")
+def shrink_rnn_memory_grad(ctx, ins, attrs):
+    """reference: ShrinkRNNMemoryGradOp — scatter dOut back into the
+    full-size memory, zero for rows past the active prefix."""
+    x = np.asarray(ins["X"][0])
+    d_out = np.asarray(ins["Out@GRAD"][0])
+    dx = np.zeros_like(x)
+    dx[:d_out.shape[0]] = d_out
+    return {"X@GRAD": [jnp.asarray(dx)]}
+
+
+@register_op("split_lod_tensor", stop_gradient_op=True, jittable=False)
+def split_lod_tensor(ctx, ins, attrs):
+    """reference: split_lod_tensor_op.cc — route rows by a bool mask
+    (IfElse input split)."""
+    x = ins["X"][0]
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    dense = not isinstance(x, RaggedTensor)
+    vals = np.asarray(x if dense else x.values)
+    out_true = vals[mask] if dense else None
+    out_false = vals[~mask] if dense else None
+    if dense:
+        return {"OutTrue": [jnp.asarray(out_true)],
+                "OutFalse": [jnp.asarray(out_false)]}
+    splits = np.asarray(x.row_splits[-1])
+    rows_t, st_t, rows_f, st_f = [], [0], [], [0]
+    for i in range(len(splits) - 1):
+        seg = vals[splits[i]:splits[i + 1]]
+        if mask[i]:
+            rows_t.append(seg)
+            st_t.append(st_t[-1] + len(seg))
+        else:
+            rows_f.append(seg)
+            st_f.append(st_f[-1] + len(seg))
+    cat = lambda rs: (np.concatenate(rs, 0) if rs else vals[:0])
+    return {
+        "OutTrue": [RaggedTensor(jnp.asarray(cat(rows_t)),
+                                 [np.asarray(st_t, np.int32)])],
+        "OutFalse": [RaggedTensor(jnp.asarray(cat(rows_f)),
+                                  [np.asarray(st_f, np.int32)])],
+    }
+
+
+@register_op("merge_lod_tensor", stop_gradient_op=True, jittable=False)
+def merge_lod_tensor(ctx, ins, attrs):
+    """reference: merge_lod_tensor_op.cc — inverse routing (IfElse
+    output merge)."""
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    t_in, f_in = ins["InTrue"][0], ins["InFalse"][0]
+    if isinstance(t_in, RaggedTensor) or isinstance(f_in, RaggedTensor):
+        # interleave true/false sequences back into mask order,
+        # rebuilding row_splits (symmetric with split_lod_tensor).
+        def _segs(r):
+            if not isinstance(r, RaggedTensor):
+                v = np.asarray(r)
+                return [v[i:i + 1] for i in range(len(v))]
+            v, sp = np.asarray(r.values), np.asarray(r.row_splits[-1])
+            return [v[sp[i]:sp[i + 1]] for i in range(len(sp) - 1)]
+
+        segs_t, segs_f = _segs(t_in), _segs(f_in)
+        n_true = int(mask.sum())
+        if len(segs_t) != n_true or len(segs_f) != len(mask) - n_true:
+            raise ValueError(
+                "merge_lod_tensor: mask selects %d true / %d false rows "
+                "but InTrue has %d and InFalse has %d sequences"
+                % (n_true, len(mask) - n_true, len(segs_t), len(segs_f)))
+        seg_t, seg_f = iter(segs_t), iter(segs_f)
+        segs, splits = [], [0]
+        for m in mask:
+            seg = next(seg_t) if m else next(seg_f)
+            segs.append(seg)
+            splits.append(splits[-1] + len(seg))
+        if segs:
+            vals = np.concatenate(segs, 0)
+        else:  # empty mask: keep the input's trailing dims/dtype
+            proto = t_in if isinstance(t_in, RaggedTensor) else f_in
+            vals = np.asarray(proto.values)[:0]
+        return {"Out": [RaggedTensor(jnp.asarray(vals),
+                                     [np.asarray(splits, np.int32)])]}
+    in_true = np.asarray(t_in)
+    in_false = np.asarray(f_in)
+    width = in_true.shape[1:] if in_true.size else in_false.shape[1:]
+    out = np.zeros((len(mask),) + width,
+                   in_true.dtype if in_true.size else in_false.dtype)
+    out[mask] = in_true
+    out[~mask] = in_false
+    return {"Out": [jnp.asarray(out)]}
+
+
+for _t in ("lod_rank_table", "reorder_lod_tensor_by_rank",
+           "lod_tensor_to_array", "array_to_lod_tensor",
+           "shrink_rnn_memory", "split_lod_tensor", "merge_lod_tensor"):
+    _gi(_t).infer_shape = _array_infer_shape

@@ -1,0 +1,370 @@
+"""Convolution / pooling / vision op kernels.
+
+TPU-native equivalents of reference ops (paddle/operators/conv_op.cc,
+conv_cudnn_op.cu.cc, conv_transpose_op.cc, pool_op.cc,
+pool_with_index_op.cc, lrn_op.cc, maxout_op.cc, spp_op.cc, unpool_op.cc,
+roi_pool_op.cc, im2sequence_op.cc).  All lower to
+lax.conv_general_dilated / lax.reduce_window, which XLA tiles onto the
+MXU / VPU — the reference's im2col+gemm and cuDNN paths have no analog
+here by design.  Data layout is NCHW at the API (reference parity); XLA
+re-lays out internally for the TPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .amp_util import mxu_operands, conv_acc_kwargs, amp_result
+from ..core.ragged import RaggedTensor
+
+
+@register_op("conv2d")
+def conv2d(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    xm, wm = mxu_operands(x, w)
+    out = lax.conv_general_dilated(
+        xm, wm, window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv2d", x)
+    return {"Output": [amp_result(out, x.dtype)]}
+
+
+def _check_spatial(out, opname, x):
+    """A kernel/stride combination larger than the input silently
+    yields a zero-sized spatial dim and a baffling error far
+    downstream (e.g. a reshape ZeroDivision in the first fc) — fail
+    HERE with the shapes instead.  Only the spatial dims (2:) are
+    checked: an empty batch or channel dim is the caller's business."""
+    if 0 in out.shape[2:]:
+        raise ValueError(
+            "%s produced an empty output %s from input %s — the input "
+            "spatial size is too small for this kernel/stride/padding"
+            % (opname, tuple(out.shape), tuple(x.shape)))
+    return out
+
+
+@register_op("conv3d")
+def conv3d(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    xm, wm = mxu_operands(x, w)
+    out = lax.conv_general_dilated(
+        xm, wm, window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv3d", x)
+    return {"Output": [amp_result(out, x.dtype)]}
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Filter"][0]  # [in_c, out_c, kh, kw] (reference layout)
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    # transposed conv = gradient of conv w.r.t. its input: dilate the
+    # input by `strides`, convolve with the spatially-flipped filter
+    # (reference conv_transpose_op.cc computes it the same way via the
+    # conv backward-data path)
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    xm, wm = mxu_operands(x, jnp.flip(jnp.swapaxes(w, 0, 1), (2, 3)))
+    out = lax.conv_general_dilated(
+        xm, wm,
+        window_strides=(1, 1),
+        padding=[(kh - 1 - paddings[0], kh - 1 - paddings[0]),
+                 (kw - 1 - paddings[1], kw - 1 - paddings[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv2d_transpose", x)
+    return {"Output": [amp_result(out, x.dtype)]}
+
+
+def _pool2d_impl(x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", [1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = [1, 1]
+        paddings = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides4, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides4, pads)
+        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+            # per-window valid counts depend only on static shapes:
+            # compute them on host so XLA doesn't constant-fold a full
+            # reduce-window over a ones tensor at compile time
+            counts = _np_pool_counts(
+                (x.shape[2], x.shape[3]), ksize, strides, paddings)
+            out = summed / jnp.asarray(counts, summed.dtype)[None, None]
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return _check_spatial(out, "pool2d", x)
+
+
+def _np_pool_counts(hw, ksize, strides, paddings):
+    # the rectangular-window count factorizes per axis:
+    # counts[i, j] = rows[i] * cols[j]
+    def axis_counts(n, k, s, p):
+        ones = np.pad(np.ones(n, np.float32), (p, p))
+        return np.array([ones[i * s:i * s + k].sum()
+                         for i in range((n + 2 * p - k) // s + 1)],
+                        np.float32)
+
+    return np.outer(
+        axis_counts(hw[0], ksize[0], strides[0], paddings[0]),
+        axis_counts(hw[1], ksize[1], strides[1], paddings[1]))
+
+
+@register_op("pool2d")
+def pool2d(ctx, ins, attrs):
+    return {"Out": [_pool2d_impl(ins["X"][0], attrs)]}
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", [1, 1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = [1, 1, 1]
+        paddings = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides5,
+                                pads)
+    else:
+        out = lax.reduce_window(x, 0.0, lax.add, window, strides5, pads) \
+            / np.prod(ksize)
+    _check_spatial(out, "pool3d", x)
+    return {"Out": [out]}
+
+
+@register_op("max_pool2d_with_index", nondiff_inputs=())
+def max_pool2d_with_index(ctx, ins, attrs):
+    """reference: pool_with_index_op.cc — also returns flat argmax index
+    per window (for unpool)."""
+    x = ins["X"][0]
+    out = _pool2d_impl(x, dict(attrs, pooling_type="max"))
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", [1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [h, w]
+        strides = [1, 1]
+        paddings = [0, 0]
+    # select index of max via reduce_window over (value, index) pairs
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]))
+    _, idx = lax.reduce_window((x, flat_idx), (-jnp.inf, 0.0), reducer,
+                               window, strides4, pads)
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register_op("unpool", nondiff_inputs=("Indices",))
+def unpool(ctx, ins, attrs):
+    """reference: unpool_op.cc — scatter pooled values back to argmax
+    positions."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0]
+    n, c, h, w = x.shape
+    unpool_size = attrs.get("unpooling_size") or attrs.get("ksize", [2, 2])
+    oh = h * unpool_size[0]
+    ow = w * unpool_size[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx_flat = idx.reshape(n, c, -1)
+    x_flat = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(
+        lambda f, i, v: f.at[i].add(v)))(flat, idx_flat, x_flat)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("lrn")
+def lrn(ctx, ins, attrs):
+    """Local response normalization across channels
+    (reference: lrn_op.cc)."""
+    x = ins["X"][0]
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window_sum = sum(padded[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * window_sum
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("maxout")
+def maxout(ctx, ins, attrs):
+    """reference: maxout_op.cc — max over channel groups."""
+    x = ins["X"][0]
+    groups = int(attrs["groups"])
+    n, c, h, w = x.shape
+    out = jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)
+    return {"Out": [out]}
+
+
+@register_op("spp")
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference: spp_op.cc)."""
+    x = ins["X"][0]
+    levels = int(attrs.get("pyramid_height", 3))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh = int(np.ceil(h / bins))
+        kw = int(np.ceil(w / bins))
+        ph = int((kh * bins - h + 1) / 2)
+        pw = int((kw * bins - w + 1) / 2)
+        pooled = _pool2d_impl(x, {
+            "pooling_type": ptype, "ksize": [kh, kw],
+            "strides": [kh, kw], "paddings": [ph, pw]})
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("roi_pool", nondiff_inputs=("ROIs",))
+def roi_pool(ctx, ins, attrs):
+    """reference: roi_pool_op.cc — max pool over regions of interest."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    if isinstance(rois, RaggedTensor):
+        rois = rois.values
+    pooled_h = int(attrs["pooled_height"])
+    pooled_w = int(attrs["pooled_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def pool_one(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[batch_id]  # [c, h, w]
+        hh = jnp.arange(h)
+        ww = jnp.arange(w)
+
+        def bin_val(ph, pw):
+            hstart = y1 + (ph * roi_h) // pooled_h
+            hend = y1 + ((ph + 1) * roi_h + pooled_h - 1) // pooled_h
+            wstart = x1 + (pw * roi_w) // pooled_w
+            wend = x1 + ((pw + 1) * roi_w + pooled_w - 1) // pooled_w
+            mask = ((hh[:, None] >= hstart) & (hh[:, None] < hend) &
+                    (ww[None, :] >= wstart) & (ww[None, :] < wend))
+            vals = jnp.where(mask[None], img, -jnp.inf)
+            m = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)
+
+        grid = jnp.stack([
+            jnp.stack([bin_val(ph, pw) for pw in range(pooled_w)], -1)
+            for ph in range(pooled_h)], -2)
+        return grid  # [c, pooled_h, pooled_w]
+
+    out = jax.vmap(pool_one)(rois.astype(x.dtype))
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("im2sequence", nondiff_inputs=())
+def im2sequence(ctx, ins, attrs):
+    """reference: im2sequence_op.cc — image patches to a ragged sequence
+    (one sequence per image, one step per patch position)."""
+    x = ins["X"][0]
+    kernels = attrs.get("kernels", [1, 1])
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                     (paddings[1], paddings[3])))
+    kh, kw = kernels
+    sh, sw = strides
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=[(paddings[0], paddings[2]), (paddings[1], paddings[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [n, c*kh*kw, oh, ow] -> [n*oh*ow, c*kh*kw]
+    seq = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    splits = jnp.arange(n + 1, dtype=jnp.int32) * (oh * ow)
+    return {"Out": [RaggedTensor(seq, [splits])]}
+
+
+@register_op("conv2d_dynamic_filter")
+def conv2d_dynamic_filter(ctx, ins, attrs):
+    """Per-sample dynamic-filter convolution: each batch element is
+    convolved with its own filter row (reference: ConvOperator.cpp via
+    layers.py conv_operator — the mixed-layer operator whose filter is
+    another layer's output, not a parameter).  Lowered to a vmap of
+    single-image convs; XLA batches them onto the MXU."""
+    x = ins["Input"][0]                        # [B, C, H, W]
+    w = ins["Filter"][0]                       # [B, F*C*kh*kw]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0]))
+    f = int(attrs["num_filters"])
+    kh, kw = attrs.get("ksize", [3, 3])
+    c = x.shape[1]
+
+    def one(img, flt):
+        im, fm = mxu_operands(img[None], flt.reshape(f, c, kh, kw))
+        out = lax.conv_general_dilated(
+            im, fm, window_strides=strides,
+            padding=[(paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            **conv_acc_kwargs(im, fm))
+        return out[0]
+
+    out = jax.vmap(one)(x, w)
+    _check_spatial(out, "conv2d_dynamic_filter", x)
+    return {"Output": [amp_result(out, x.dtype)]}

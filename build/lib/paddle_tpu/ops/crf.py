@@ -1,0 +1,250 @@
+"""Linear-chain CRF ops.
+
+TPU-native equivalents of the reference CRF family
+(reference: paddle/operators/linear_chain_crf_op.cc — forward alpha
+recursion + NLL; crf_decoding_op.cc — Viterbi; chunk_eval_op.cc —
+chunk-level precision/recall/F1).
+
+Design departures:
+  * linear_chain_crf runs the forward recursion as a masked lax.scan over
+    a padded [B, Tmax, D] batch (the reference loops per sequence on CPU,
+    linear_chain_crf_op.h:129).  Log-space throughout (the reference uses
+    L1-normalized exp space, linear_chain_crf_op.h:158).  Gradients come
+    from jax.vjp of the forward — no hand-written backward
+    (linear_chain_crf_op.h:218 is the hand-rolled one).
+  * crf_decoding / chunk_eval are host ops (jittable=False): the reference
+    registers them CPU-only too; they are eval-path.
+
+Transition layout (reference linear_chain_crf_op.cc:29-33): row 0 =
+start weights a, row 1 = end weights b, rows 2.. = transition matrix w
+([D, D], w[i, j] = score of tag i -> tag j).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+from .sequence import ragged_to_padded
+
+
+def _pad_batch(emission, label=None):
+    e_pad, lengths = ragged_to_padded(emission)  # [B, Tmax, D]
+    l_pad = None
+    if label is not None:
+        l_rt = label if isinstance(label, RaggedTensor) else None
+        assert l_rt is not None, "CRF Label must be a sequence (ragged)"
+        lp, _ = ragged_to_padded(l_rt.with_values(
+            l_rt.values.reshape(-1, 1).astype(jnp.int32)))
+        l_pad = lp[:, :, 0]
+    return e_pad, l_pad, lengths
+
+
+@register_op("linear_chain_crf", nondiff_inputs=("Label",))
+def linear_chain_crf(ctx, ins, attrs):
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    label = ins["Label"][0]
+    e_pad, l_pad, lengths = _pad_batch(emission, label)
+    B, Tmax, D = e_pad.shape
+    a = transition[0]          # start weights
+    b = transition[1]          # end weights
+    w = transition[2:]         # [D, D]
+
+    t_idx = jnp.arange(Tmax)
+
+    # ---- logZ: masked forward recursion ---------------------------------
+    def step(alpha, inputs):
+        e_t, active = inputs          # [B, D], [B]
+        new = jax.nn.logsumexp(alpha[:, :, None] + w[None], axis=1) + e_t
+        alpha = jnp.where(active[:, None], new, alpha)
+        return alpha, alpha
+
+    alpha0 = a[None] + e_pad[:, 0]
+    active = (t_idx[None, :] < lengths[:, None])  # [B, Tmax]
+    alpha_last, alphas = lax.scan(
+        step, alpha0,
+        (jnp.swapaxes(e_pad, 0, 1)[1:], jnp.swapaxes(active, 0, 1)[1:]))
+    log_z = jax.nn.logsumexp(alpha_last + b[None], axis=-1)  # [B]
+
+    # ---- gold path score -------------------------------------------------
+    lbl = jnp.clip(l_pad, 0, D - 1)
+    e_at_lbl = jnp.take_along_axis(e_pad, lbl[:, :, None],
+                                   axis=2)[:, :, 0]          # [B, Tmax]
+    e_score = jnp.sum(jnp.where(active, e_at_lbl, 0.0), axis=1)
+    trans_score = w[lbl[:, :-1], lbl[:, 1:]]                 # [B, Tmax-1]
+    trans_active = active[:, 1:]
+    t_score = jnp.sum(jnp.where(trans_active, trans_score, 0.0), axis=1)
+    last_pos = jnp.maximum(lengths - 1, 0)
+    last_lbl = jnp.take_along_axis(lbl, last_pos[:, None], axis=1)[:, 0]
+    score = a[lbl[:, 0]] + e_score + t_score + b[last_lbl]
+
+    nll = (log_z - score).reshape(-1, 1)
+
+    # workspace outputs kept for reference parity (grads come from vjp)
+    from .sequence import padded_to_ragged
+
+    alphas_full = jnp.concatenate([alpha0[None], alphas], axis=0)
+    alpha_rt = padded_to_ragged(jnp.swapaxes(alphas_full, 0, 1), emission)
+    return {"Alpha": [alpha_rt],
+            "EmissionExps": [emission.with_values(jnp.exp(emission.values))],
+            "TransitionExps": [jnp.exp(transition)],
+            "LogLikelihood": [nll]}
+
+
+@register_op("crf_decoding", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Emission", "Transition", "Label"))
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (reference: crf_decoding_op.h).  With Label given,
+    outputs 1 where the decoded tag equals the label, else 0."""
+    emission = ins["Emission"][0]
+    transition = np.asarray(ins["Transition"][0], np.float64)
+    a, b, w = transition[0], transition[1], transition[2:]
+    splits = np.asarray(emission.last_splits())
+    values = np.asarray(emission.values, np.float64)
+    nvalid = int(np.asarray(emission.nvalid))
+
+    path = np.zeros((values.shape[0], 1), np.int32)
+    for s in range(len(splits) - 1):
+        lo, hi = int(splits[s]), int(splits[s + 1])
+        if hi <= lo:
+            continue
+        x = values[lo:hi]
+        T, D = x.shape
+        delta = a + x[0]
+        back = np.zeros((T, D), np.int32)
+        for t in range(1, T):
+            cand = delta[:, None] + w
+            back[t] = cand.argmax(axis=0)
+            delta = cand.max(axis=0) + x[t]
+        delta = delta + b
+        tags = np.zeros(T, np.int32)
+        tags[T - 1] = int(delta.argmax())
+        for t in range(T - 1, 0, -1):
+            tags[t - 1] = back[t, tags[t]]
+        path[lo:hi, 0] = tags
+
+    if ins.get("Label") and ins["Label"][0] is not None:
+        lbl = ins["Label"][0]
+        lv = np.asarray(lbl.values).reshape(-1).astype(np.int32)
+        match = (path[:nvalid, 0] == lv[:nvalid]).astype(np.int32)
+        out = np.zeros_like(path)
+        out[:nvalid, 0] = match
+        path = out
+    return {"ViterbiPath": [emission.with_values(jnp.asarray(path))]}
+
+
+def _extract_chunks(tags, num_types, scheme, excluded):
+    """-> set of (begin, end, type) chunks (reference: chunk_eval_op.h
+    Segment extraction).  Tag encoding per scheme:
+      plain: tag == type
+      IOB:   tag = type*2 + (0 begin | 1 inside)
+      IOE:   tag = type*2 + (0 inside | 1 end)
+      IOBES: tag = type*4 + (0 begin | 1 inside | 2 end | 3 single)
+    with one extra 'outside' tag = num_types*tag_width."""
+    chunks = []
+    n = len(tags)
+    i = 0
+    if scheme == "plain":
+        while i < n:
+            t = tags[i]
+            if 0 <= t < num_types:
+                j = i
+                while j + 1 < n and tags[j + 1] == t:
+                    j += 1
+                chunks.append((i, j, t))
+                i = j + 1
+            else:
+                i += 1
+    elif scheme == "IOB":
+        while i < n:
+            t = tags[i]
+            if 0 <= t < num_types * 2:
+                ctype, pos = divmod(t, 2)
+                j = i
+                while (j + 1 < n and tags[j + 1] == ctype * 2 + 1):
+                    j += 1
+                chunks.append((i, j, ctype))
+                i = j + 1
+            else:
+                i += 1
+    elif scheme == "IOE":
+        while i < n:
+            t = tags[i]
+            if 0 <= t < num_types * 2:
+                ctype = t // 2
+                j = i
+                while j < n and tags[j] == ctype * 2 and j + 1 < n and \
+                        tags[j + 1] // 2 == ctype:
+                    j += 1
+                if j < n and tags[j] // 2 == ctype:
+                    chunks.append((i, j, ctype))
+                    i = j + 1
+                else:
+                    i += 1
+            else:
+                i += 1
+    elif scheme == "IOBES":
+        while i < n:
+            t = tags[i]
+            if 0 <= t < num_types * 4:
+                ctype, pos = divmod(t, 4)
+                if pos == 3:  # single
+                    chunks.append((i, i, ctype))
+                    i += 1
+                elif pos == 0:  # begin
+                    j = i
+                    while (j + 1 < n and tags[j + 1] // 4 == ctype and
+                           tags[j + 1] % 4 == 1):
+                        j += 1
+                    if j + 1 < n and tags[j + 1] // 4 == ctype and \
+                            tags[j + 1] % 4 == 2:
+                        j += 1
+                    chunks.append((i, j, ctype))
+                    i = j + 1
+                else:
+                    i += 1
+            else:
+                i += 1
+    else:
+        raise ValueError("unknown chunk scheme %r" % scheme)
+    return {(b, e, t) for (b, e, t) in chunks if t not in excluded}
+
+
+@register_op("chunk_eval", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Inference", "Label"))
+def chunk_eval(ctx, ins, attrs):
+    inference = ins["Inference"][0]
+    label = ins["Label"][0]
+    num_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = set(attrs.get("excluded_chunk_types") or [])
+
+    splits = np.asarray(label.last_splits())
+    inf_v = np.asarray(inference.values).reshape(-1)
+    lbl_v = np.asarray(label.values).reshape(-1)
+
+    num_infer = num_label = num_correct = 0
+    for s in range(len(splits) - 1):
+        lo, hi = int(splits[s]), int(splits[s + 1])
+        ic = _extract_chunks(inf_v[lo:hi].tolist(), num_types, scheme,
+                             excluded)
+        lc = _extract_chunks(lbl_v[lo:hi].tolist(), num_types, scheme,
+                             excluded)
+        num_infer += len(ic)
+        num_label += len(lc)
+        num_correct += len(ic & lc)
+
+    precision = num_correct / num_infer if num_infer else 0.0
+    recall = num_correct / num_label if num_label else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if num_correct else 0.0)
+    f32 = np.float32
+    return {"Precision": [np.asarray([precision], f32)],
+            "Recall": [np.asarray([recall], f32)],
+            "F1-Score": [np.asarray([f1], f32)],
+            "NumInferChunks": [np.asarray([num_infer], np.int32)],
+            "NumLabelChunks": [np.asarray([num_label], np.int32)],
+            "NumCorrectChunks": [np.asarray([num_correct], np.int32)]}

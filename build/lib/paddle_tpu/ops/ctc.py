@@ -1,0 +1,197 @@
+"""CTC ops: warpctc loss, ctc_align (greedy decode), edit_distance,
+sequence_erase.
+
+TPU-native equivalents of the reference CTC family (reference:
+paddle/operators/warpctc_op.cc — dlopen'ed libwarpctc; ctc_align_op.cc;
+edit_distance_op.cc; sequence_erase_op.cc).
+
+Design departures:
+  * warpctc is a native XLA lowering: log-space alpha recursion over the
+    extended label sequence as a masked lax.scan on a padded batch — no
+    external library, and gradients come from jax.vjp of the forward (the
+    reference reuses warpctc's internal gradient via the WarpCTCGrad
+    workspace output, warpctc_op.h).
+  * ctc_align / edit_distance / sequence_erase produce dynamically-sized
+    sequences, so they are host ops (the reference's versions are also
+    trivially small); they are eval/data-path, never inside a jitted
+    training step.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+from .sequence import ragged_to_padded
+
+NEG_INF = -1e30
+
+
+@register_op("warpctc", nondiff_inputs=("Label",))
+def warpctc(ctx, ins, attrs):
+    logits = ins["Logits"][0]    # ragged [T, C]
+    label = ins["Label"][0]      # ragged [L, 1] int
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+
+    lg_pad, t_lens = ragged_to_padded(logits)        # [B, Tmax, C]
+    lb = label.with_values(label.values.reshape(-1, 1).astype(jnp.int32))
+    lb_pad, l_lens = ragged_to_padded(lb)            # [B, Lmax, 1]
+    lb_pad = lb_pad[:, :, 0]
+    B, Tmax, C = lg_pad.shape
+    Lmax = lb_pad.shape[1]
+    S = 2 * Lmax + 1
+
+    logp = jax.nn.log_softmax(lg_pad, axis=-1)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    s_idx = jnp.arange(S)
+    is_lbl = (s_idx % 2) == 1
+    lbl_pos = jnp.clip(s_idx // 2, 0, Lmax - 1)
+    ext = jnp.where(is_lbl[None, :], lb_pad[:, lbl_pos], blank)  # [B, S]
+    # valid extended positions: s < 2*L_b + 1
+    s_valid = s_idx[None, :] < (2 * l_lens[:, None] + 1)
+    # skip transition allowed: ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, ext.dtype),
+                              ext[:, :-2]], axis=1)
+    can_skip = is_lbl[None, :] & (ext != ext_m2)
+
+    def gather_logp(lp_t):
+        return jnp.take_along_axis(lp_t, ext, axis=1)  # [B, S]
+
+    alpha0 = jnp.full((B, S), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(l_lens > 0, gather_logp(logp[:, 0])[:, 1], NEG_INF))
+    alpha0 = jnp.where(s_valid, alpha0, NEG_INF)
+
+    t_range = jnp.arange(Tmax)
+    active = t_range[None, :] < t_lens[:, None]      # [B, Tmax]
+
+    def step(alpha, inputs):
+        lp_t, act = inputs
+        a_m1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        a_m2 = jnp.where(can_skip, a_m2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_m1), a_m2)
+        new = merged + gather_logp(lp_t)
+        new = jnp.where(s_valid, new, NEG_INF)
+        alpha = jnp.where(act[:, None], new, alpha)
+        return alpha, None
+
+    alpha_last, _ = lax.scan(
+        step, alpha0,
+        (jnp.swapaxes(logp, 0, 1)[1:], jnp.swapaxes(active, 0, 1)[1:]))
+
+    # final: logsumexp of states 2L and 2L-1
+    end1 = 2 * l_lens               # final blank
+    end2 = jnp.maximum(2 * l_lens - 1, 0)  # final label
+    a_end1 = jnp.take_along_axis(alpha_last, end1[:, None], axis=1)[:, 0]
+    a_end2 = jnp.take_along_axis(alpha_last, end2[:, None], axis=1)[:, 0]
+    a_end2 = jnp.where(l_lens > 0, a_end2, NEG_INF)
+    loss = -jnp.logaddexp(a_end1, a_end2)
+    if norm_by_times:
+        loss = loss / jnp.maximum(t_lens, 1).astype(loss.dtype)
+    return {"Loss": [loss.reshape(-1, 1)],
+            "WarpCTCGrad": [logits.with_values(
+                jnp.zeros_like(logits.values))]}
+
+
+@register_op("ctc_align", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Input",))
+def ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode: merge repeated tokens then drop blanks
+    (reference: ctc_align_op.h)."""
+    x = ins["Input"][0]
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    splits = np.asarray(x.last_splits())
+    vals = np.asarray(x.values).reshape(-1)
+
+    out_vals = []
+    out_splits = [0]
+    for s in range(len(splits) - 1):
+        seq = vals[int(splits[s]):int(splits[s + 1])]
+        if merge and len(seq):
+            keep = np.ones(len(seq), bool)
+            keep[1:] = seq[1:] != seq[:-1]
+            seq = seq[keep]
+        seq = seq[seq != blank]
+        out_vals.extend(seq.tolist())
+        out_splits.append(len(out_vals))
+    out = np.asarray(out_vals, np.int32).reshape(-1, 1)
+    if out.size == 0:
+        out = np.zeros((0, 1), np.int32)
+    return {"Output": [RaggedTensor(jnp.asarray(out),
+                                    [np.asarray(out_splits, np.int64)])]}
+
+
+def _levenshtein(hyp, ref):
+    m, n = len(hyp), len(ref)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    prev = np.arange(n + 1, dtype=np.int64)
+    for i in range(1, m + 1):
+        cur = np.empty(n + 1, np.int64)
+        cur[0] = i
+        for j in range(1, n + 1):
+            cost = 0 if hyp[i - 1] == ref[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        prev = cur
+    return int(prev[n])
+
+
+@register_op("edit_distance", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Hyps", "Refs"))
+def edit_distance(ctx, ins, attrs):
+    hyps = ins["Hyps"][0]
+    refs = ins["Refs"][0]
+    normalized = bool(attrs.get("normalized", False))
+    ignored = set(attrs.get("ignored_tokens") or [])
+
+    h_splits = np.asarray(hyps.last_splits())
+    r_splits = np.asarray(refs.last_splits())
+    hv = np.asarray(hyps.values).reshape(-1)
+    rv = np.asarray(refs.values).reshape(-1)
+    B = len(h_splits) - 1
+    out = np.zeros((B, 1), np.float32)
+    for s in range(B):
+        h = [t for t in hv[int(h_splits[s]):int(h_splits[s + 1])].tolist()
+             if t not in ignored]
+        r = [t for t in rv[int(r_splits[s]):int(r_splits[s + 1])].tolist()
+             if t not in ignored]
+        d = _levenshtein(h, r)
+        if normalized:
+            d = d / max(len(r), 1)
+        out[s, 0] = d
+    return {"Out": [out],
+            "SequenceNum": [np.asarray([B], np.int32)]}
+
+
+@register_op("sequence_erase", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("X",))
+def sequence_erase(ctx, ins, attrs):
+    """Remove given tokens from each sequence (reference:
+    sequence_erase_op.cc)."""
+    x = ins["X"][0]
+    tokens = set(attrs.get("tokens") or [])
+    splits = np.asarray(x.last_splits())
+    vals = np.asarray(x.values).reshape(-1)
+    out_vals = []
+    out_splits = [0]
+    for s in range(len(splits) - 1):
+        seq = [t for t in vals[int(splits[s]):int(splits[s + 1])].tolist()
+               if t not in tokens]
+        out_vals.extend(seq)
+        out_splits.append(len(out_vals))
+    out = np.asarray(out_vals, np.asarray(x.values).dtype).reshape(-1, 1)
+    if out.size == 0:
+        out = np.zeros((0, 1), np.asarray(x.values).dtype)
+    return {"Out": [RaggedTensor(jnp.asarray(out),
+                                 [np.asarray(out_splits, np.int64)])]}

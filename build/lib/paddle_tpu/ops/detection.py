@@ -1,0 +1,485 @@
+"""Detection ops (SSD family).
+
+TPU-native equivalents of the reference detection family
+(reference: paddle/operators/prior_box_op.cc, iou_similarity_op.cc,
+bipartite_match_op.cc, detection_output_op.cc).
+
+prior_box and iou_similarity are pure XLA (vectorized, no loops).
+bipartite_match and detection_output (NMS) are host ops: both are
+greedy sequential algorithms with data-dependent trip counts, and the
+reference runs bipartite_match CPU-only as well.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+@register_op("prior_box", stop_gradient_op=True,
+             nondiff_inputs=("Input", "Image"))
+def prior_box(ctx, ins, attrs):
+    """reference: prior_box_op.h — boxes [H, W, num_priors, 4] in
+    normalized (xmin, ymin, xmax, ymax)."""
+    feat = ins["Input"][0]
+    image = ins["Image"][0]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes") or []]
+    aspect_ratios = [float(a) for a in attrs.get("aspect_ratios") or [1.0]]
+    variances = [float(v) for v in
+                 attrs.get("variances") or [0.1, 0.1, 0.2, 0.2]]
+    flip = bool(attrs.get("flip", True))
+    clip = bool(attrs.get("clip", True))
+    offset = float(attrs.get("offset", 0.5))
+
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = float(attrs.get("step_w") or 0.0) or img_w / W
+    step_h = float(attrs.get("step_h") or 0.0) or img_h / H
+
+    # expanded aspect ratio list (reference: ExpandAspectRatios)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    # per-position (w, h) of each prior
+    pw, ph = [], []
+    for s, ms in enumerate(min_sizes):
+        pw.append(ms / 2.0)
+        ph.append(ms / 2.0)
+        if max_sizes:
+            big = np.sqrt(ms * max_sizes[s])
+            pw.append(big / 2.0)
+            ph.append(big / 2.0)
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            pw.append(ms * np.sqrt(ar) / 2.0)
+            ph.append(ms / np.sqrt(ar) / 2.0)
+    num_priors = len(pw)
+    pw = jnp.asarray(pw, jnp.float32)
+    ph = jnp.asarray(ph, jnp.float32)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cx = cx[None, :, None]  # [1, W, 1]
+    cy = cy[:, None, None]  # [H, 1, 1]
+    xmin = (cx - pw[None, None, :]) / img_w
+    xmax = (cx + pw[None, None, :]) / img_w
+    ymin = (cy - ph[None, None, :]) / img_h
+    ymax = (cy + ph[None, None, :]) / img_h
+    boxes = jnp.stack(
+        [jnp.broadcast_to(xmin, (H, W, num_priors)),
+         jnp.broadcast_to(ymin, (H, W, num_priors)),
+         jnp.broadcast_to(xmax, (H, W, num_priors)),
+         jnp.broadcast_to(ymax, (H, W, num_priors))], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, num_priors, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _iou(x, y):
+    """x: [N, 4], y: [M, 4] -> [N, M] IoU (xmin, ymin, xmax, ymax)."""
+    area_x = jnp.maximum(x[:, 2] - x[:, 0], 0) * \
+        jnp.maximum(x[:, 3] - x[:, 1], 0)
+    area_y = jnp.maximum(y[:, 2] - y[:, 0], 0) * \
+        jnp.maximum(y[:, 3] - y[:, 1], 0)
+    ix_min = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy_min = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix_max = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy_max = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(ix_max - ix_min, 0) * \
+        jnp.maximum(iy_max - iy_min, 0)
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", stop_gradient_op=True,
+             nondiff_inputs=("X", "Y"))
+def iou_similarity(ctx, ins, attrs):
+    """reference: iou_similarity_op.h — X may be a ragged [N, 4] per-image
+    box list; Y is [M, 4]."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    xv = x.values if isinstance(x, RaggedTensor) else x
+    out = _iou(xv, y)
+    if isinstance(x, RaggedTensor):
+        return {"Out": [x.with_values(out)]}
+    return {"Out": [out]}
+
+
+@register_op("bipartite_match", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("DistMat",))
+def bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching per image (reference:
+    bipartite_match_op.cc:44 BipartiteMatch).  DistMat may be ragged
+    (per-image row blocks)."""
+    dist_t = ins["DistMat"][0]
+    ragged = isinstance(dist_t, RaggedTensor)
+    if ragged:
+        splits = np.asarray(dist_t.last_splits())
+        dist = np.asarray(dist_t.values)
+    else:
+        dist = np.asarray(dist_t)
+        splits = np.asarray([0, dist.shape[0]], np.int64)
+    n_img = len(splits) - 1
+    col = dist.shape[1]
+    match_indices = np.full((n_img, col), -1, np.int32)
+    match_dist = np.zeros((n_img, col), np.float32)
+    for i in range(n_img):
+        sub = dist[int(splits[i]):int(splits[i + 1])]
+        row_pool = list(range(sub.shape[0]))
+        while row_pool:
+            best = (-1, -1, -1.0)
+            for j in range(col):
+                if match_indices[i, j] != -1:
+                    continue
+                for m in row_pool:
+                    d = sub[m, j]
+                    if d < 1e-6:
+                        continue
+                    if d > best[2]:
+                        best = (m, j, float(d))
+            if best[0] < 0:
+                break
+            m, j, d = best
+            match_indices[i, j] = m
+            match_dist[i, j] = d
+            row_pool.remove(m)
+    return {"ColToRowMatchIndices": [match_indices],
+            "ColToRowMatchDis": [match_dist]}
+
+
+def _nms(boxes, scores, nms_threshold, top_k):
+    """Greedy per-class NMS -> kept indices (reference:
+    detection_output_op.h ApplyNMSFast)."""
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(int(i))
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        ious = np.asarray(_iou(jnp.asarray(boxes[i][None]),
+                               jnp.asarray(boxes[rest])))[0]
+        order = rest[ious <= nms_threshold]
+    return keep
+
+
+@register_op("detection_output", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("Loc", "Conf", "PriorBox"))
+def detection_output(ctx, ins, attrs):
+    """SSD detection output: decode loc predictions against priors,
+    per-class NMS, keep top_k (reference: detection_output_op.h).
+
+    Loc:  [N, num_priors * 4] location predictions.
+    Conf: [N, num_priors * num_classes] class scores (softmaxed here).
+    PriorBox: [num_priors * 2, 4] — boxes then variances (reference
+    stores priors and variances interleaved rows).
+    Out: [M, 7] rows (image_id, label, score, xmin, ymin, xmax, ymax);
+    M == 1 row of -1s when nothing passes (reference keeps shape [1, 7]).
+    """
+    loc = np.asarray(ins["Loc"][0])
+    conf = np.asarray(ins["Conf"][0])
+    prior = np.asarray(ins["PriorBox"][0]).reshape(-1, 4)
+    num_classes = int(attrs["num_classes"])
+    background = int(attrs.get("background_label_id", 0))
+    nms_threshold = float(attrs.get("nms_threshold", 0.45))
+    conf_threshold = float(attrs.get("confidence_threshold", 0.01))
+    top_k = int(attrs.get("top_k", 100))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+
+    n_prior = prior.shape[0] // 2
+    pboxes = prior[:n_prior]
+    pvars = prior[n_prior:]
+    N = loc.shape[0]
+    loc = loc.reshape(N, n_prior, 4)
+    conf = conf.reshape(N, n_prior, num_classes)
+    # softmax over classes
+    e = np.exp(conf - conf.max(axis=-1, keepdims=True))
+    conf = e / e.sum(axis=-1, keepdims=True)
+
+    # decode (reference: variance-encoded center-size decoding)
+    pw = pboxes[:, 2] - pboxes[:, 0]
+    ph = pboxes[:, 3] - pboxes[:, 1]
+    pcx = (pboxes[:, 0] + pboxes[:, 2]) / 2
+    pcy = (pboxes[:, 1] + pboxes[:, 3]) / 2
+    dcx = pvars[:, 0] * loc[:, :, 0] * pw + pcx
+    dcy = pvars[:, 1] * loc[:, :, 1] * ph + pcy
+    dw = np.exp(pvars[:, 2] * loc[:, :, 2]) * pw
+    dh = np.exp(pvars[:, 3] * loc[:, :, 3]) * ph
+    decoded = np.stack([dcx - dw / 2, dcy - dh / 2,
+                        dcx + dw / 2, dcy + dh / 2], axis=-1)
+
+    rows = []
+    for n in range(N):
+        all_dets = []
+        for c in range(num_classes):
+            if c == background:
+                continue
+            scores = conf[n, :, c]
+            mask = scores > conf_threshold
+            if not mask.any():
+                continue
+            idx = np.where(mask)[0]
+            keep = _nms(decoded[n, idx], scores[idx], nms_threshold,
+                        nms_top_k)
+            for k in keep:
+                i = idx[k]
+                all_dets.append((float(scores[i]), c, decoded[n, i]))
+        all_dets.sort(key=lambda d: -d[0])
+        for score, c, box in all_dets[:top_k]:
+            rows.append([float(n), float(c), score,
+                         float(box[0]), float(box[1]),
+                         float(box[2]), float(box[3])])
+    if not rows:
+        rows = [[-1.0] * 7]
+    return {"Out": [np.asarray(rows, np.float32)]}
+
+
+@register_op("multibox_loss",
+             nondiff_inputs=("PriorBox", "GtBox", "GtLabel"))
+def multibox_loss(ctx, ins, attrs):
+    """SSD training loss (reference: MultiBoxLossLayer.cpp via
+    multibox_loss_layer, layers.py): per-prediction IoU matching,
+    variance-encoded smooth-L1 location loss on positives, softmax
+    confidence loss with 3:1 hard-negative mining.
+
+    Unlike the reference's sequential CPU matching, everything here is
+    a fixed-shape masked computation — matching, mining, and both
+    losses trace into one XLA program, so the op is differentiable
+    w.r.t. Loc/Conf and fuses into the training step.
+
+    Loc: [N, P*4]; Conf: [N, P*C]; PriorBox: [2P, 4] (boxes then
+    variances); GtBox: ragged [G, 4]; GtLabel: ragged [G, 1].
+    Loss: [N, 1] per-image cost.
+    """
+    num_classes = int(attrs["num_classes"])
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    background = int(attrs.get("background_label_id", 0))
+
+    loc = ins["Loc"][0]
+    conf = ins["Conf"][0]
+    prior = ins["PriorBox"][0].reshape(-1, 4)
+    gt_box_t = ins["GtBox"][0]
+    gt_label_t = ins["GtLabel"][0]
+
+    n_prior = prior.shape[0] // 2
+    pboxes, pvars = prior[:n_prior], prior[n_prior:]
+    N = loc.shape[0]
+    loc = loc.reshape(N, n_prior, 4)
+    conf = conf.reshape(N, n_prior, num_classes)
+
+    gt_boxes = gt_box_t.values if isinstance(gt_box_t, RaggedTensor) \
+        else gt_box_t
+    gt_labels = (gt_label_t.values if isinstance(gt_label_t,
+                                                 RaggedTensor)
+                 else gt_label_t).reshape(-1).astype(jnp.int32)
+    if isinstance(gt_box_t, RaggedTensor):
+        splits = gt_box_t.last_splits()
+    else:
+        splits = jnp.asarray([0, gt_boxes.shape[0]], jnp.int32)
+    G = gt_boxes.shape[0]
+    # image membership of each gt row: img[g] = n iff splits[n] <= g
+    img_of_gt = jnp.searchsorted(splits[1:], jnp.arange(G), side="right")
+
+    iou = _iou(pboxes, gt_boxes)                      # [P, G]
+    member = img_of_gt[None, :] == jnp.arange(N)[:, None, None]  # [N,1,G]
+    iou_n = jnp.where(member, iou[None], -1.0)        # [N, P, G]
+    best_gt = jnp.argmax(iou_n, axis=-1)              # [N, P]
+    best_iou = jnp.take_along_axis(iou_n, best_gt[..., None],
+                                   -1)[..., 0]        # [N, P]
+    positive = best_iou >= overlap_threshold
+
+    # bipartite step (reference: MultiBoxLossLayer.cpp matches each gt
+    # to its best prior unconditionally BEFORE per-prediction
+    # thresholding) — without it a gt whose best IoU is under the
+    # threshold would contribute no gradient at all
+    valid_gt = member[:, 0, :]                        # [N, G]
+    best_prior = jnp.argmax(iou_n, axis=1)            # [N, G]
+    gt_hits_prior = (jax.nn.one_hot(best_prior, n_prior, dtype=bool)
+                     & valid_gt[..., None])           # [N, G, P]
+    forced = jnp.any(gt_hits_prior, axis=1)           # [N, P]
+    # a forced prior adopts its highest-IoU forcing gt
+    forced_iou = jnp.where(jnp.swapaxes(gt_hits_prior, 1, 2),
+                           iou[None], -1.0)           # [N, P, G]
+    best_gt = jnp.where(forced, jnp.argmax(forced_iou, -1), best_gt)
+    positive = positive | forced
+
+    matched_box = gt_boxes[best_gt]                   # [N, P, 4]
+    matched_label = gt_labels[best_gt]                # [N, P]
+
+    # encode matched gt against priors (center-size, variance-scaled)
+    pw = pboxes[:, 2] - pboxes[:, 0]
+    ph = pboxes[:, 3] - pboxes[:, 1]
+    pcx = (pboxes[:, 0] + pboxes[:, 2]) / 2
+    pcy = (pboxes[:, 1] + pboxes[:, 3]) / 2
+    gw = jnp.maximum(matched_box[..., 2] - matched_box[..., 0], 1e-6)
+    gh = jnp.maximum(matched_box[..., 3] - matched_box[..., 1], 1e-6)
+    gcx = (matched_box[..., 0] + matched_box[..., 2]) / 2
+    gcy = (matched_box[..., 1] + matched_box[..., 3]) / 2
+    target = jnp.stack(
+        [(gcx - pcx) / pw / pvars[:, 0], (gcy - pcy) / ph / pvars[:, 1],
+         jnp.log(gw / pw) / pvars[:, 2], jnp.log(gh / ph) / pvars[:, 3]],
+        axis=-1)                                      # [N, P, 4]
+
+    diff = jnp.abs(loc - target)
+    smooth_l1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    loc_loss = jnp.sum(jnp.sum(smooth_l1, -1) * positive, -1)  # [N]
+
+    # softmax CE per prior; positives use the matched label,
+    # negatives the background class
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    cls = jnp.where(positive, matched_label, background)
+    ce = -jnp.take_along_axis(logp, cls[..., None], -1)[..., 0]  # [N,P]
+
+    # hard negative mining: keep the neg_pos_ratio * npos highest-loss
+    # negatives per image (rank via argsort-of-argsort, fixed shapes)
+    npos = jnp.sum(positive, -1)                      # [N]
+    neg_ce = jnp.where(positive, -jnp.inf, ce)
+    order = jnp.argsort(-neg_ce, axis=-1)
+    rank = jnp.argsort(order, axis=-1)
+    n_neg = jnp.minimum(neg_pos_ratio * npos, n_prior - npos)
+    negative = (~positive) & (rank < n_neg[:, None])
+    conf_loss = jnp.sum(ce * (positive | negative), -1)  # [N]
+
+    denom = jnp.maximum(npos.astype(loc.dtype), 1.0)
+    loss = (loc_loss + conf_loss) / denom
+    return {"Loss": [loss[:, None]]}
+
+
+@register_op("detection_map", stop_gradient_op=True, jittable=False,
+             nondiff_inputs=("DetectRes", "Label"))
+def detection_map(ctx, ins, attrs):
+    """Mean average precision over detection results (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp — 11point or integral
+    AP, greedy best-IoU matching of score-ranked detections against
+    per-image ground truth).
+
+    DetectRes: ragged rows [label, score, xmin, ymin, xmax, ymax]
+    (the detection_output op's layout minus the image column — image
+    identity comes from the lod).  Label: ragged rows
+    [label, xmin, ymin, xmax, ymax] (+ optional difficult flag last).
+    MAP: [1] float.
+    """
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    background = int(attrs.get("background_label_id", 0))
+    ap_type = attrs.get("ap_type", "11point")
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", False))
+
+    det_t, gt_t = ins["DetectRes"][0], ins["Label"][0]
+
+    def unpack(t):
+        if isinstance(t, RaggedTensor):
+            return (np.asarray(t.values)[:int(np.asarray(t.nvalid))],
+                    np.asarray(t.last_splits()))
+        v = np.asarray(t)
+        return v, np.asarray([0, v.shape[0]], np.int64)
+
+    det, det_splits = unpack(det_t)
+    gt, gt_splits = unpack(gt_t)
+    n_img = len(det_splits) - 1
+    has_difficult = gt.shape[1] >= 6
+
+    # per-class pools: detections (img, score, box), gt (img, box, hard)
+    by_class_det, by_class_gt = {}, {}
+    for i in range(n_img):
+        for r in det[det_splits[i]:det_splits[i + 1]]:
+            c = int(r[0])
+            if c != background:
+                by_class_det.setdefault(c, []).append((i, float(r[1]),
+                                                       r[2:6]))
+        for r in gt[gt_splits[i]:gt_splits[i + 1]]:
+            c = int(r[0])
+            hard = bool(r[5]) if has_difficult else False
+            if c != background:
+                by_class_gt.setdefault(c, []).append((i, r[1:5], hard))
+
+    def _iou_np(a, b):
+        """numpy twin of _iou for this host op: [N,4]x[M,4] -> [N,M]."""
+        area_a = np.maximum(a[:, 2] - a[:, 0], 0) * \
+            np.maximum(a[:, 3] - a[:, 1], 0)
+        area_b = np.maximum(b[:, 2] - b[:, 0], 0) * \
+            np.maximum(b[:, 3] - b[:, 1], 0)
+        ix = np.maximum(
+            np.minimum(a[:, None, 2], b[None, :, 2])
+            - np.maximum(a[:, None, 0], b[None, :, 0]), 0)
+        iy = np.maximum(
+            np.minimum(a[:, None, 3], b[None, :, 3])
+            - np.maximum(a[:, None, 1], b[None, :, 1]), 0)
+        inter = ix * iy
+        union = area_a[:, None] + area_b[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    aps = []
+    for c, gts in by_class_gt.items():
+        npos = sum(1 for _, _, hard in gts
+                   if evaluate_difficult or not hard)
+        dets = sorted(by_class_det.get(c, []), key=lambda d: -d[1])
+        # one IoU matrix per class (host numpy, no per-pair dispatch)
+        iou_mat = None
+        if dets:
+            iou_mat = _iou_np(np.stack([d[2] for d in dets]),
+                              np.stack([g[1] for g in gts]))
+        gt_imgs = np.asarray([g[0] for g in gts])
+        matched = set()
+        tps, fps = [], []
+        for di, (img, _score, _box) in enumerate(dets):
+            # VOC protocol (reference DetectionMAPEvaluator): take the
+            # best-IoU gt in the image regardless of matched state; a
+            # duplicate detection of a matched gt is a FALSE POSITIVE,
+            # never re-matched to a lesser gt
+            cand = np.where(gt_imgs == img)[0]
+            if cand.size == 0:
+                tps.append(0.0)
+                fps.append(1.0)
+                continue
+            ious = iou_mat[di, cand]
+            k = int(np.argmax(ious))
+            best_j, best_iou = int(cand[k]), float(ious[k])
+            if best_iou >= overlap_threshold:
+                hard = gts[best_j][2]
+                if hard and not evaluate_difficult:
+                    tps.append(0.0)  # difficult gt: neither tp nor fp
+                    fps.append(0.0)
+                elif best_j not in matched:
+                    matched.add(best_j)
+                    tps.append(1.0)
+                    fps.append(0.0)
+                else:  # duplicate detection
+                    tps.append(0.0)
+                    fps.append(1.0)
+            else:
+                tps.append(0.0)
+                fps.append(1.0)
+        if npos == 0:
+            continue
+        tp_cum = np.cumsum(tps)
+        fp_cum = np.cumsum(fps)
+        recall = tp_cum / npos
+        precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+        if ap_type == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                mask = recall >= t
+                ap += (precision[mask].max() if mask.any() else 0.0) / 11
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for p, r in zip(precision, recall):
+                ap += p * (r - prev_r)
+                prev_r = r
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return {"MAP": [np.asarray([m], np.float32)]}

@@ -1,0 +1,81 @@
+"""Distributed send/recv host ops.
+
+TPU-native equivalent of the reference's send/recv pair (reference:
+paddle/operators/send_op.cc:35 — gRPC client shipping grads,
+recv_op.cc:86 — server applying the optimizer and serving back params).
+`dist_send` is one round trip: ship gradient blocks to their pservers
+(native framed-TCP clients), block until the (sync-mode) aggregated
+update applies, write the fresh parameter back.  Runs host-side
+(jittable=False): XLA finishes forward+backward on-device, then this op
+does DCN IO.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import SelectedRows
+
+
+def _bname(pname, begin):
+    return "%s@%d" % (pname, begin)
+
+
+class ClientPool:
+    """One native connection per endpoint per process."""
+
+    _clients = {}
+
+    @classmethod
+    def get(cls, endpoint):
+        c = cls._clients.get(endpoint)
+        if c is None:
+            from .. import native
+
+            host, port = endpoint.rsplit(":", 1)
+            c = native.PServerClient(host, int(port))
+            cls._clients[endpoint] = c
+        return c
+
+    @classmethod
+    def reset(cls):
+        for c in cls._clients.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        cls._clients.clear()
+
+
+@register_op("dist_send", jittable=False, stop_gradient_op=True,
+             in_place_outputs=("ParamOut",))
+def dist_send(ctx, ins, attrs):
+    param = ins["Param"][0]
+    grad = ins["Grad"][0]
+    pname = attrs["param_name"]
+    blocks = attrs["blocks"]
+
+    if isinstance(grad, SelectedRows):
+        # sparse path: rows only (reference: SelectedRows transfer +
+        # getParameterSparse ParameterServer2.h:510); sparse params are
+        # assigned whole to one endpoint by the transpiler
+        ep = blocks[0][0]
+        c = ClientPool.get(ep)
+        rows = np.asarray(grad.rows)
+        vals = np.asarray(grad.values).reshape(rows.shape[0], -1)
+        c.send_sparse_grad(_bname(pname, 0), rows, vals)
+        uniq = np.unique(rows)
+        got = c.get_rows(_bname(pname, 0), uniq, vals.shape[1])
+        p = np.array(param)
+        p.reshape(p.shape[0], -1)[uniq] = got
+        return {"ParamOut": [jnp.asarray(p)]}
+
+    flat = np.asarray(param).reshape(-1)
+    g = np.asarray(grad, dtype=np.float32).reshape(-1)
+    out = flat.astype(np.float32).copy()
+    for ep, begin, size in blocks:
+        c = ClientPool.get(ep)
+        out[begin:begin + size] = c.send_grad(
+            _bname(pname, begin), g[begin:begin + size])
+    return {"ParamOut": [jnp.asarray(out.reshape(param.shape),
+                                     dtype=param.dtype)]}

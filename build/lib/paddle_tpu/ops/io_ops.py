@@ -1,0 +1,99 @@
+"""Host-side IO ops: feed, fetch, save, load, print.
+
+TPU-native equivalents of reference ops (paddle/operators/feed_op.cc,
+fetch_op.cc, save_op.cc, load_op.cc, print_op.cc).  These are the
+non-jittable ops that split a block into compiled segments; they run on
+host between XLA executions, matching the reference's interleaved
+executor semantics.
+"""
+
+import os
+
+import numpy as np
+import jax
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+@register_op("feed", jittable=False, stop_gradient_op=True)
+def feed(ctx, ins, attrs):
+    feed_list = ctx.scope.get("feed") or []
+    col = int(attrs.get("col", 0))
+    return {"Out": [feed_list[col]]}
+
+
+@register_op("fetch", jittable=False, stop_gradient_op=True)
+def fetch(ctx, ins, attrs):
+    col = int(attrs.get("col", 0))
+    fetch_list = ctx.scope.get("fetch") or []
+    while len(fetch_list) <= col:
+        fetch_list.append(None)
+    fetch_list[col] = ins["X"][0]
+    ctx.scope.set("fetch", fetch_list)
+    return {}
+
+
+def _var_file(dirname, name):
+    return os.path.join(dirname, name.replace("/", "_"))
+
+
+@register_op("save", jittable=False, stop_gradient_op=True)
+def save(ctx, ins, attrs):
+    """reference save_op.cc: one raw tensor file per var."""
+    path = attrs["file_path"]
+    overwrite = attrs.get("overwrite", True)
+    if os.path.exists(path) and not overwrite:
+        raise RuntimeError("%r exists and overwrite=False" % path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    x = ins["X"][0]
+    if isinstance(x, RaggedTensor):
+        np.savez(path, __ragged__=1, values=np.asarray(x.values),
+                 nvalid=np.asarray(x.nvalid),
+                 **{"rs%d" % i: np.asarray(rs)
+                    for i, rs in enumerate(x.row_splits)})
+    else:
+        np.savez(path, __ragged__=0, values=np.asarray(x))
+    return {}
+
+
+@register_op("load", jittable=False, stop_gradient_op=True)
+def load(ctx, ins, attrs):
+    path = attrs["file_path"]
+    real = path if os.path.exists(path) else path + ".npz"
+    with np.load(real) as data:
+        if int(data["__ragged__"]) == 1:
+            splits = []
+            i = 0
+            while "rs%d" % i in data:
+                splits.append(data["rs%d" % i])
+                i += 1
+            out = RaggedTensor(jax.numpy.asarray(data["values"]), splits,
+                              nvalid=int(data["nvalid"]))
+        else:
+            out = jax.device_put(data["values"],
+                                 ctx.place.device() if ctx.place else None)
+    return {"Out": [out]}
+
+
+@register_op("print", jittable=False)
+def print_op(ctx, ins, attrs):
+    """reference print_op.cc: tensor debugger; forwards input unchanged."""
+    x = ins["In"][0] if "In" in ins else ins["X"][0]
+    msg = attrs.get("message", "")
+    arr = x.values if isinstance(x, RaggedTensor) else x
+    arr = np.asarray(arr)
+    parts = [msg]
+    if attrs.get("print_tensor_name", True):
+        parts.append("var")
+    if attrs.get("print_tensor_shape", True):
+        parts.append("shape=%s" % (arr.shape,))
+    if attrs.get("print_tensor_dtype", True):
+        parts.append("dtype=%s" % arr.dtype)
+    summarize = int(attrs.get("summarize", -1))
+    flat = arr.reshape(-1)
+    if summarize > 0:
+        flat = flat[:summarize]
+    parts.append("data=%s" % (flat,))
+    print(" ".join(str(p) for p in parts))
+    return {"Out": [x]}

@@ -1,0 +1,149 @@
+"""Loss op kernels.
+
+TPU-native equivalents of reference loss ops (paddle/operators/
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, hinge_loss_op.cc,
+huber_loss_op.cc, log_loss_op.cc, margin_rank_loss_op.cc,
+modified_huber_loss_op.cc, rank_loss_op.cc, smooth_l1_loss_op.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+def _vals(v):
+    x = v.values if isinstance(v, RaggedTensor) else v
+    # losses always compute/accumulate in f32: bf16 activations
+    # (FLAGS_amp_bf16_act) upcast at the loss boundary -- e.g. log_loss's
+    # 1e-4 epsilon would be absorbed entirely by bf16 rounding near p=1
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    return x
+
+
+def _label_1d(label):
+    l = _vals(label)
+    if l.ndim > 1:
+        l = jnp.reshape(l, (-1,))
+    return l.astype(jnp.int32)
+
+
+@register_op("cross_entropy", nondiff_inputs=("Label",))
+def cross_entropy(ctx, ins, attrs):
+    xr = ins["X"][0]
+    x = _vals(xr)
+    label = ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        l = _vals(label)
+        out = -jnp.sum(l * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        l = _label_1d(label)
+        picked = jnp.take_along_axis(x, l[:, None], axis=-1)
+        out = -jnp.log(picked + eps)
+    if isinstance(xr, RaggedTensor):
+        return {"Y": [xr.with_values(out)]}
+    return {"Y": [out]}
+
+
+@register_op("softmax_with_cross_entropy", nondiff_inputs=("Label",))
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = _vals(ins["Logits"][0])
+    label = ins["Label"][0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        l = _vals(label)
+        loss = -jnp.sum(l * logp, axis=-1, keepdims=True)
+    else:
+        l = _label_1d(label)
+        loss = -jnp.take_along_axis(logp, l[:, None], axis=-1)
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x = _vals(ins["X"][0])
+    label = _vals(ins["Label"][0]).astype(x.dtype)
+    # stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register_op("hinge_loss")
+def hinge_loss(ctx, ins, attrs):
+    logits = _vals(ins["Logits"][0])
+    labels = _vals(ins["Labels"][0]).astype(logits.dtype)
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)]}
+
+
+@register_op("huber_loss")
+def huber_loss(ctx, ins, attrs):
+    x = _vals(ins["X"][0])
+    y = _vals(ins["Y"][0])
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    return {"Residual": [r], "Out": [loss]}
+
+
+@register_op("log_loss")
+def log_loss(ctx, ins, attrs):
+    p = _vals(ins["Predicted"][0])
+    l = _vals(ins["Labels"][0]).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("rank_loss")
+def rank_loss(ctx, ins, attrs):
+    label = _vals(ins["Label"][0])
+    left = _vals(ins["Left"][0])
+    right = _vals(ins["Right"][0])
+    d = left - right
+    loss = jnp.log1p(jnp.exp(d)) - label * d
+    return {"Out": [loss]}
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ctx, ins, attrs):
+    label = _vals(ins["Label"][0])
+    x1 = _vals(ins["X1"][0])
+    x2 = _vals(ins["X2"][0])
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    act = (out > 0).astype(x1.dtype)
+    return {"Out": [out], "Activated": [act]}
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(ctx, ins, attrs):
+    x = _vals(ins["X"][0])
+    y = _vals(ins["Y"][0])
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    return {"IntermediateVal": [z], "Out": [loss]}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx, ins, attrs):
+    x = _vals(ins["X"][0])
+    y = _vals(ins["Y"][0])
+    sigma = attrs.get("sigma", 1.0)
+    sigma2 = sigma * sigma
+    d = x - y
+    if "InsideWeight" in ins:
+        d = d * _vals(ins["InsideWeight"][0])
+    ad = jnp.abs(d)
+    val = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * d * d,
+                    ad - 0.5 / sigma2)
+    if "OutsideWeight" in ins:
+        val = val * _vals(ins["OutsideWeight"][0])
+    out = jnp.sum(val, axis=tuple(range(1, val.ndim)))
+    return {"Diff": [d], "Out": [jnp.reshape(out, (-1, 1))]}

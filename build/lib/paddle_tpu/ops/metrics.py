@@ -1,0 +1,145 @@
+"""Metric op kernels: accuracy, auc, precision_recall.
+
+TPU-native equivalents of reference metric ops (paddle/operators/
+accuracy_op.cc, auc_op.cc, precision_recall_op.cc).
+"""
+
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+def _vals(v):
+    return v.values if isinstance(v, RaggedTensor) else v
+
+
+@register_op("accuracy", stop_gradient_op=True,
+             nondiff_inputs=("Out", "Indices", "Label"))
+def accuracy(ctx, ins, attrs):
+    """ins: Out (top-k values, unused), Indices (top-k [N,k]), Label [N,1].
+    reference: accuracy_op.h AccuracyKernel."""
+    indices = _vals(ins["Indices"][0]).astype(jnp.int32)
+    label = _vals(ins["Label"][0]).astype(jnp.int32)
+    label = jnp.reshape(label, (-1, 1))
+    hit = jnp.any(indices == label, axis=1)
+    num = jnp.asarray(indices.shape[0], jnp.int32)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    acc = correct.astype(jnp.float32) / num.astype(jnp.float32)
+    return {"Accuracy": [jnp.reshape(acc, (1,))],
+            "Correct": [jnp.reshape(correct, (1,))],
+            "Total": [jnp.reshape(num, (1,))]}
+
+
+@register_op("auc", stop_gradient_op=True,
+             nondiff_inputs=("Out", "Indices", "Label"))
+def auc(ctx, ins, attrs):
+    """Approximate AUC by thresholding (reference: auc_op.h with
+    num_thresholds buckets)."""
+    preds = _vals(ins["Out"][0])
+    label = jnp.reshape(_vals(ins["Label"][0]).astype(jnp.int32), (-1,))
+    if preds.ndim == 2 and preds.shape[1] >= 2:
+        score = preds[:, 1]
+    else:
+        score = jnp.reshape(preds, (-1,))
+    n_th = int(attrs.get("num_thresholds", 200))
+    ths = jnp.linspace(0.0, 1.0, n_th)
+    pred_pos = score[None, :] > ths[:, None]          # [T, N]
+    pos = (label == 1)[None, :]
+    tp = jnp.sum(pred_pos & pos, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred_pos & ~pos, axis=1).astype(jnp.float32)
+    npos = jnp.maximum(jnp.sum(pos), 1).astype(jnp.float32)
+    nneg = jnp.maximum(jnp.sum(~pos), 1).astype(jnp.float32)
+    tpr = tp / npos
+    fpr = fp / nneg
+    # trapezoid over decreasing fpr
+    auc_val = jnp.sum((tpr[:-1] + tpr[1:]) * (fpr[:-1] - fpr[1:]) / 2.0)
+    return {"AUC": [jnp.reshape(auc_val, (1,))]}
+
+
+@register_op("precision_recall", stop_gradient_op=True,
+             nondiff_inputs=("MaxProbs", "Indices", "Labels", "Weights",
+                             "StatesInfo"))
+def precision_recall(ctx, ins, attrs):
+    """Macro/micro precision-recall-F1 over classes
+    (reference: precision_recall_op.h)."""
+    cls = int(attrs["class_number"])
+    idx = jnp.reshape(_vals(ins["Indices"][0]).astype(jnp.int32), (-1,))
+    labels = jnp.reshape(_vals(ins["Labels"][0]).astype(jnp.int32), (-1,))
+    onehot_pred = jnp.eye(cls, dtype=jnp.float32)[idx]
+    onehot_lab = jnp.eye(cls, dtype=jnp.float32)[labels]
+    tp = jnp.sum(onehot_pred * onehot_lab, axis=0)
+    fp = jnp.sum(onehot_pred * (1 - onehot_lab), axis=0)
+    fn = jnp.sum((1 - onehot_pred) * onehot_lab, axis=0)
+    states = jnp.stack([tp, fp, fn, jnp.zeros_like(tp)], axis=1)
+    if "StatesInfo" in ins:
+        states = states + _vals(ins["StatesInfo"][0]).astype(jnp.float32)
+        tp, fp, fn = states[:, 0], states[:, 1], states[:, 2]
+    prec = tp / jnp.maximum(tp + fp, 1e-6)
+    rec = tp / jnp.maximum(tp + fn, 1e-6)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    tps, fps, fns = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    mprec = tps / jnp.maximum(tps + fps, 1e-6)
+    mrec = tps / jnp.maximum(tps + fns, 1e-6)
+    mf1 = 2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-6)
+    micro = jnp.stack([mprec, mrec, mf1])
+    return {"BatchMetrics": [jnp.concatenate([macro, micro])],
+            "AccumMetrics": [jnp.concatenate([macro, micro])],
+            "AccumStatesInfo": [states]}
+
+
+@register_op("positive_negative_pair", stop_gradient_op=True,
+             jittable=False,
+             nondiff_inputs=("Score", "Label", "QueryID", "Weight",
+                             "AccumulatePositivePair",
+                             "AccumulateNegativePair",
+                             "AccumulateNeutralPair"))
+def positive_negative_pair(ctx, ins, attrs):
+    """Per-query ranking pair statistics (reference:
+    positive_negative_pair_op.h PositiveNegativePairKernel)."""
+    import numpy as np
+
+    score = np.asarray(_vals(ins["Score"][0]))
+    label = np.asarray(_vals(ins["Label"][0])).reshape(-1)
+    query = np.asarray(_vals(ins["QueryID"][0])).reshape(-1)
+    weight = None
+    if ins.get("Weight") and ins["Weight"][0] is not None:
+        weight = np.asarray(_vals(ins["Weight"][0])).reshape(-1)
+    column = int(attrs.get("column", 0))
+    if column < 0:
+        column += score.shape[1]
+    s = score[:, column]
+
+    pos = neg = neu = 0.0
+    for q in np.unique(query):
+        idx = np.where(query == q)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                w = ((weight[i] + weight[j]) / 2.0
+                     if weight is not None else 1.0)
+                if label[i] == label[j]:
+                    continue
+                same = (s[i] == s[j])
+                correct = (s[i] > s[j]) == (label[i] > label[j])
+                if same:
+                    neu += w
+                elif correct:
+                    pos += w
+                else:
+                    neg += w
+
+    def _acc(slot):
+        v = ins.get(slot)
+        if v and v[0] is not None:
+            return float(np.asarray(v[0]).reshape(-1)[0])
+        return 0.0
+
+    pos += _acc("AccumulatePositivePair")
+    neg += _acc("AccumulateNegativePair")
+    neu += _acc("AccumulateNeutralPair")
+    f32 = np.float32
+    return {"PositivePair": [np.asarray([pos], f32)],
+            "NegativePair": [np.asarray([neg], f32)],
+            "NeutralPair": [np.asarray([neu], f32)]}

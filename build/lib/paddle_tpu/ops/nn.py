@@ -1,0 +1,145 @@
+"""Misc NN op kernels: lstm_unit, nce, bilinear, conv_shift, etc.
+
+TPU-native equivalents of reference ops (paddle/operators/lstm_unit_op.cc,
+nce_op.cc, bilinear_tensor_product_op.cc, conv_shift_op.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx, ins, attrs):
+    """One fused LSTM cell step (reference: lstm_unit_op.cc): X holds the
+    four pre-activation gates [i f o g] concatenated."""
+    x = ins["X"][0]            # [N, 4D]
+    c_prev = ins["C_prev"][0]  # [N, D]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    d = c_prev.shape[1]
+    i, f, o, g = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    o = jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("nce", uses_rng=True, nondiff_inputs=("Label",))
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation loss (reference: nce_op.cc/.h).
+    Negative samples are drawn uniformly, matching the reference's
+    Sampler default."""
+    x = ins["Input"][0]            # [N, D]
+    label = ins["Label"][0]        # [N, num_true]
+    w = ins["Weight"][0]           # [C, D]
+    b = ins["Bias"][0] if "Bias" in ins else None  # [C, 1]
+    num_classes = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    n = x.shape[0]
+    label = jnp.reshape(label, (n, -1)).astype(jnp.int32)
+    num_true = label.shape[1]
+
+    key = ctx.next_rng()
+    neg = jax.random.randint(key, (n, num_neg), 0, num_classes)
+    samples = jnp.concatenate([label, neg], axis=1)  # [N, T+S]
+
+    sw = jnp.take(w, samples.reshape(-1), axis=0) \
+        .reshape(n, -1, w.shape[1])                  # [N, T+S, D]
+    logits = jnp.einsum("nd,nsd->ns", x, sw)
+    if b is not None:
+        logits = logits + jnp.take(jnp.reshape(b, (-1,)),
+                                   samples.reshape(-1)).reshape(n, -1)
+    p_model = jax.nn.sigmoid(logits)
+    p_noise = 1.0 / num_classes
+    # true part
+    true_p = p_model[:, :num_true]
+    true_cost = -jnp.log(true_p / (true_p + num_true * p_noise) + 1e-12)
+    neg_p = p_model[:, num_true:]
+    neg_cost = -jnp.log(num_true * p_noise /
+                        (neg_p + num_true * p_noise) + 1e-12)
+    cost = jnp.sum(true_cost, 1, keepdims=True) + \
+        jnp.sum(neg_cost, 1, keepdims=True)
+    return {"Cost": [cost], "SampleLogits": [logits],
+            "SampleLabels": [samples]}
+
+
+from .registry import register_grad_kernel
+
+
+@register_grad_kernel("nce")
+def nce_grad(ctx, ins, attrs):
+    """Replays the saved samples (SampleLabels) instead of the RNG."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    samples = ins["O@SampleLabels"][0]
+    og = ins["OG@Cost"][0]
+    num_classes = int(attrs["num_total_classes"])
+    label = ins["Label"][0]
+    n = x.shape[0]
+    num_true = jnp.reshape(label, (n, -1)).shape[1]
+
+    def f(x_, w_, b_):
+        sw = jnp.take(w_, samples.reshape(-1), axis=0) \
+            .reshape(n, -1, w_.shape[1])
+        logits = jnp.einsum("nd,nsd->ns", x_, sw)
+        if b_ is not None:
+            logits = logits + jnp.take(jnp.reshape(b_, (-1,)),
+                                       samples.reshape(-1)).reshape(n, -1)
+        p_model = jax.nn.sigmoid(logits)
+        p_noise = 1.0 / num_classes
+        true_p = p_model[:, :num_true]
+        true_cost = -jnp.log(true_p / (true_p + num_true * p_noise)
+                             + 1e-12)
+        neg_p = p_model[:, num_true:]
+        neg_cost = -jnp.log(num_true * p_noise /
+                            (neg_p + num_true * p_noise) + 1e-12)
+        return jnp.sum(true_cost, 1, keepdims=True) + \
+            jnp.sum(neg_cost, 1, keepdims=True)
+
+    if b is not None:
+        _, vjp = jax.vjp(f, x, w, b)
+        dx, dw, db = vjp(og)
+        return {"Input@GRAD": [dx], "Weight@GRAD": [dw],
+                "Bias@GRAD": [db]}
+    _, vjp = jax.vjp(lambda x_, w_: f(x_, w_, None), x, w)
+    dx, dw = vjp(og)
+    return {"Input@GRAD": [dx], "Weight@GRAD": [dw]}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    """out_k = x W_k y^T + b (reference: bilinear_tensor_product_op.cc)."""
+    x = ins["X"][0]  # [N, Dx]
+    y = ins["Y"][0]  # [N, Dy]
+    w = ins["Weight"][0]  # [K, Dx, Dy]
+    out = jnp.einsum("nd,kde,ne->nk", x, w, y)
+    if "Bias" in ins:
+        out = out + jnp.reshape(ins["Bias"][0], (1, -1))
+    return {"Out": [out]}
+
+
+@register_op("conv_shift")
+def conv_shift(ctx, ins, attrs):
+    """Circular correlation (reference: conv_shift_op.cc)."""
+    x = ins["X"][0]  # [N, D]
+    y = ins["Y"][0]  # [N, M], M odd, M <= D
+    n, d = x.shape
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(d)[:, None] + jnp.arange(-half, half + 1)[None, :]) % d
+    # out[i,j] = sum_k x[i, (j+k-half)%d] * y[i,k]
+    gathered = x[:, idx]             # [N, D, M]
+    out = jnp.einsum("ndm,nm->nd", gathered, y)
+    return {"Out": [out]}
+
+
+@register_op("cast_embedding_ids", stop_gradient_op=True)
+def cast_embedding_ids(ctx, ins, attrs):
+    # helper op (not in reference): int cast for id tensors
+    x = ins["X"][0]
+    return {"Out": [x.astype(jnp.int32)]}

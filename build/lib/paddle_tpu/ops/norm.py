@@ -1,0 +1,166 @@
+"""Normalization op kernels: batch_norm, layer_norm, norm (l2).
+
+TPU-native equivalents of reference ops (paddle/operators/
+batch_norm_op.cc + cudnn variant, norm_op.cc; layer_norm is provided for
+completeness though the snapshot predates it).  batch_norm has an explicit
+grad kernel because its forward mutates running stats (in-place outputs)
+which must not be differentiated through.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_kernel
+
+
+def _bn_axes(x, layout):
+    if layout == "NCHW":
+        return (tuple(i for i in range(x.ndim) if i != 1),
+                (1, -1) + (1,) * (x.ndim - 2))
+    return tuple(range(x.ndim - 1)), (1,) * (x.ndim - 1) + (-1,)
+
+
+def _bn_stats(x, axes):
+    """Batch mean/var, always accumulated in f32 (XLA fuses the convert
+    into the reduction, so a bf16 input is still read once at 2 B/elem).
+
+    Shifted one-pass form: with a per-channel reference value s,
+    var = E[(x-s)^2] - E[x-s]^2 and mean = E[x-s] + s.  Both reductions
+    still share a single sweep over the activation (XLA fuses same-input
+    reduces) — unlike jnp.var's two-pass (x - mean)^2 which reads the
+    big tensor twice — but the shift removes the catastrophic
+    cancellation of the naive E[x^2] - E[x]^2 when |mean| >> std (e.g.
+    a first BN over raw 0-255 inputs).  s is the channel's first
+    element: free to read, and any value near the data keeps the
+    cancellation benign; max(., 0) guards the round-off edge."""
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    first = tuple(slice(0, 1) if i in axes else slice(None)
+                  for i in range(x.ndim))
+    shift = jax.lax.stop_gradient(xs[first])
+    d = xs - shift
+    dm = jnp.mean(d, axis=axes)
+    dsq = jnp.mean(jnp.square(d), axis=axes)
+    var = jnp.maximum(dsq - jnp.square(dm), 0.0)
+    return dm + jnp.reshape(shift, dm.shape), var
+
+
+def _bn_normalize(x, scale, bias, m, v, eps, bshape):
+    inv_std = jax.lax.rsqrt(v + eps)
+    if x.dtype == jnp.bfloat16:
+        # fold the f32 statistics into one per-channel affine and apply
+        # it in bf16: the big tensor is read/written at 2 B/elem and the
+        # chain fuses with the adjacent conv/relu/residual ops
+        a = scale * inv_std
+        b = bias - m * a
+        return x * a.reshape(bshape).astype(x.dtype) + \
+            b.reshape(bshape).astype(x.dtype)
+    return (x - m.reshape(bshape)) * inv_std.reshape(bshape) * \
+        scale.reshape(bshape) + bias.reshape(bshape)
+
+
+@register_op("batch_norm", nondiff_inputs=("Mean", "Variance"))
+def batch_norm(ctx, ins, attrs):
+    """reference: batch_norm_op.cc — training mode uses batch statistics
+    and updates running stats with `momentum`; test mode uses running
+    stats."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    mean = ins["Mean"][0]
+    variance = ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+
+    axes, bshape = _bn_axes(x, layout)
+
+    if is_test:
+        use_mean, use_var = mean, variance
+        mean_out, var_out = mean, variance
+        saved_mean = mean
+        saved_var = variance
+    else:
+        use_mean, use_var = _bn_stats(x, axes)
+        mean_out = momentum * mean + (1 - momentum) * use_mean
+        var_out = momentum * variance + (1 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+
+    y = _bn_normalize(x, scale, bias, use_mean, use_var, eps, bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register_grad_kernel("batch_norm")
+def batch_norm_grad(ctx, ins, attrs):
+    """Explicit vjp of the normalization (running-stat updates carry no
+    gradient; reference: batch_norm_op.cc BatchNormGradKernel)."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    dy = ins["OG@Y"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+    mean = ins["Mean"][0]
+    variance = ins["Variance"][0]
+
+    def f(x_, scale_, bias_):
+        axes, bshape = _bn_axes(x_, layout)
+        if is_test:
+            m, v = mean, variance
+        else:
+            m, v = _bn_stats(x_, axes)
+        return _bn_normalize(x_, scale_, bias_, m, v, eps, bshape)
+
+    _, vjp = jax.vjp(f, x, scale, bias)
+    dx, dscale, dbias = vjp(dy)
+    return {"X@GRAD": [dx], "Scale@GRAD": [dscale], "Bias@GRAD": [dbias]}
+
+
+@register_op("layer_norm")
+def layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    begin = int(attrs.get("begin_norm_axis", 1))
+    eps = attrs.get("epsilon", 1e-5)
+    lead = 1
+    for d in x.shape[:begin]:
+        lead *= d
+    x2 = x.reshape(lead, -1)
+    x2s = x2 if x2.dtype == jnp.float32 else x2.astype(jnp.float32)
+    m = jnp.mean(x2s, axis=1, keepdims=True)
+    v = jnp.var(x2s, axis=1, keepdims=True)
+    norm = ((x2s - m) * jax.lax.rsqrt(v + eps)).astype(x.dtype)
+    if "Scale" in ins:
+        norm = norm * ins["Scale"][0].reshape(1, -1).astype(x.dtype)
+    if "Bias" in ins:
+        norm = norm + ins["Bias"][0].reshape(1, -1).astype(x.dtype)
+    return {"Y": [norm.reshape(x.shape)], "Mean": [m.reshape(lead)],
+            "Variance": [v.reshape(lead)]}
+
+
+@register_op("norm")
+def norm(ctx, ins, attrs):
+    """L2-normalize along axis (reference: norm_op.cc)."""
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    eps = attrs.get("epsilon", 1e-12)
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    n = jnp.sqrt(jnp.sum(jnp.square(xs), axis=axis, keepdims=True) + eps)
+    return {"Out": [(xs / n).astype(x.dtype)]}
+
+
+@register_op("one_hot", stop_gradient_op=True, nondiff_inputs=("X",))
+def one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    from ..core.ragged import RaggedTensor
+
+    ragged = isinstance(x, RaggedTensor)
+    ids = x.values if ragged else x
+    depth = int(attrs["depth"])
+    flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    out = jax.nn.one_hot(flat, depth, dtype=jnp.float32)
+    if ragged:
+        return {"Out": [x.with_values(out)]}
+    return {"Out": [out]}

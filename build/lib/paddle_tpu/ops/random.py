@@ -1,0 +1,82 @@
+"""Random / init op kernels and dropout.
+
+TPU-native equivalents of reference ops (paddle/operators/
+uniform_random_op.cc, gaussian_random_op.cc, dropout_op.cc).  Randomness is
+a functional PRNG stream threaded through compiled segments by the executor
+(no stateful cuRAND analog); ops honoring the reference `seed` attr use a
+fixed key for reproducibility.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_kernel
+from ..core.types import np_dtype
+from ..core.ragged import RaggedTensor
+
+
+def _key(ctx, attrs):
+    seed = int(attrs.get("seed", 0) or 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.next_rng()
+
+
+@register_op("uniform_random", uses_rng=True, stop_gradient_op=True)
+def uniform_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    out = jax.random.uniform(_key(ctx, attrs), shape, dtype=jnp.float32,
+                             minval=lo, maxval=hi).astype(dtype)
+    return {"Out": [out]}
+
+
+@register_op("gaussian_random", uses_rng=True, stop_gradient_op=True)
+def gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = mean + std * jax.random.normal(_key(ctx, attrs), shape,
+                                         dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("dropout", uses_rng=True)
+def dropout(ctx, ins, attrs):
+    xr = ins["X"][0]
+    x = xr.values if isinstance(xr, RaggedTensor) else xr
+    prob = attrs.get("dropout_prob", 0.5)
+    if attrs.get("is_test", False):
+        # reference dropout_op.h: test mode scales by (1 - p)
+        out = x * (1.0 - prob)
+        mask = jnp.ones_like(x)
+    else:
+        if attrs.get("fix_seed", False):
+            key = jax.random.PRNGKey(int(attrs.get("seed", 0)))
+        else:
+            key = ctx.next_rng()
+        mask = (jax.random.uniform(key, x.shape) >= prob).astype(x.dtype)
+        out = x * mask
+    if isinstance(xr, RaggedTensor):
+        return {"Out": [xr.with_values(out)], "Mask": [mask]}
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register_grad_kernel("dropout")
+def dropout_grad(ctx, ins, attrs):
+    """Uses the saved forward Mask (reference: dropout_op.h DropoutGradKernel)
+    — the RNG must not be replayed."""
+    og = ins["OG@Out"][0]
+    mask = ins["O@Mask"][0]
+    ogr = og
+    g = og.values if isinstance(og, RaggedTensor) else og
+    if attrs.get("is_test", False):
+        out = g * (1.0 - attrs.get("dropout_prob", 0.5))
+    else:
+        out = g * mask
+    if isinstance(ogr, RaggedTensor):
+        return {"X@GRAD": [ogr.with_values(out)]}
+    return {"X@GRAD": [out]}

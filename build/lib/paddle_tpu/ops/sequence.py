@@ -1,0 +1,610 @@
+"""Sequence op kernels over RaggedTensors.
+
+TPU-native equivalents of the reference's LoD sequence ops
+(paddle/operators/sequence_pool_op.cc, sequence_conv_op.cc,
+sequence_expand_op.cc, sequence_concat_op.cc, sequence_reshape_op.cc,
+sequence_slice_op.cc, sequence_erase_op.cc, sequence_softmax_op.cc,
+lod_reset_op.cc, lstm_op.cc + math/lstm_compute, gru_op.cc +
+math/gru_compute, row_conv_op.cc, operators/math/sequence2batch.h).
+
+Representation: RaggedTensor = flat values [T, ...] + int32 row_splits
+(exactly the reference's LoD offsets) with static shapes.  Reductions use
+segment ops; recurrences convert ragged -> padded [B, maxT] -> lax.scan ->
+ragged, replacing the reference's sequence2batch reordering engine.  All
+of it differentiates through jax.vjp (no hand-written grad kernels).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .amp_util import mxu_operands, acc_kwargs, amp_result
+from ..core.ragged import RaggedTensor
+
+
+def _amp_dot(a, b):
+    """Recurrent projection matmul with the MXU dtype policy (bf16
+    operands + f32 accumulation under FLAGS_amp_bf16)."""
+    dtype = jnp.result_type(a.dtype, b.dtype)
+    am, bm = mxu_operands(a, b)
+    return amp_result(jnp.dot(am, bm, **acc_kwargs(am, bm)), dtype)
+
+
+def _seg_pos(rt, level=-1):
+    """(segment_ids [T], position-in-sequence [T], valid mask [T])."""
+    rs = rt.row_splits[level]
+    nseq = rs.shape[0] - 1
+    T = rt.values.shape[0]
+    pos = jnp.arange(T, dtype=jnp.int32)
+    seg = jnp.searchsorted(rs, pos, side="right").astype(jnp.int32) - 1
+    seg = jnp.clip(seg, 0, nseq - 1)
+    starts = rs[:-1]
+    inseq = pos - starts[seg]
+    valid = pos < rt.nvalid
+    return seg, inseq, valid
+
+
+def ragged_to_padded(rt, fill=0.0):
+    """[T, ...] ragged -> ([B, T, ...] padded, lengths [B]).  maxT = T
+    (static worst case; callers on fixed-length data see no waste after
+    XLA DCE because positions beyond each length are masked)."""
+    seg, inseq, valid = _seg_pos(rt)
+    B = rt.nseq()
+    T = rt.values.shape[0]
+    fill = jnp.asarray(fill).astype(rt.values.dtype)
+    padded = jnp.full((B, T) + rt.values.shape[1:], fill, rt.values.dtype)
+    seg_s = jnp.where(valid, seg, B - 1)
+    in_s = jnp.where(valid, inseq, T - 1)
+    vals = jnp.where(valid.reshape((-1,) + (1,) * (rt.values.ndim - 1)),
+                     rt.values, fill)
+    padded = padded.at[seg_s, in_s].set(vals, mode="drop")
+    return padded, rt.seq_lengths()
+
+
+def padded_to_ragged(padded, rt_like):
+    """Inverse of ragged_to_padded using rt_like's splits."""
+    seg, inseq, valid = _seg_pos(rt_like)
+    vals = padded[seg, inseq]
+    vals = jnp.where(valid.reshape((-1,) + (1,) * (vals.ndim - 1)), vals,
+                     0.0 if jnp.issubdtype(vals.dtype, jnp.floating) else 0)
+    return RaggedTensor(vals, rt_like.row_splits, rt_like.nvalid)
+
+
+@register_op("sequence_pool")
+def sequence_pool(ctx, ins, attrs):
+    """reference: sequence_pool_op.cc — SUM/AVERAGE/SQRT/MAX/LAST/FIRST
+    over each sequence; output is a dense [B, D] tensor."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    seg, inseq, valid = _seg_pos(x)
+    B = x.nseq()
+    vmask = valid.reshape((-1,) + (1,) * (x.values.ndim - 1))
+    seg_for_sum = jnp.where(valid, seg, B)  # padding -> dropped segment
+    if ptype in ("SUM", "AVERAGE", "SQRT"):
+        s = jax.ops.segment_sum(jnp.where(vmask, x.values, 0.0),
+                                seg_for_sum, num_segments=B + 1)[:B]
+        if ptype == "AVERAGE":
+            lens = jnp.maximum(x.seq_lengths(), 1).astype(s.dtype)
+            s = s / lens.reshape((-1,) + (1,) * (s.ndim - 1))
+        elif ptype == "SQRT":
+            lens = jnp.maximum(x.seq_lengths(), 1).astype(s.dtype)
+            s = s / jnp.sqrt(lens).reshape((-1,) + (1,) * (s.ndim - 1))
+        return {"Out": [s], "MaxIndex": [jnp.zeros((B,), jnp.int32)]}
+    if ptype == "MAX":
+        neg = jnp.where(vmask, x.values, -jnp.inf)
+        s = jax.ops.segment_max(neg, seg_for_sum, num_segments=B + 1)[:B]
+        s = jnp.where(jnp.isfinite(s), s, 0.0)
+        return {"Out": [s], "MaxIndex": [jnp.zeros((B,), jnp.int32)]}
+    if ptype in ("LAST", "FIRST"):
+        rs = x.last_splits()
+        idx = jnp.clip(rs[1:] - 1 if ptype == "LAST" else rs[:-1], 0,
+                       x.values.shape[0] - 1)
+        return {"Out": [x.values[idx]],
+                "MaxIndex": [idx.astype(jnp.int32)]}
+    raise ValueError("unknown pooltype %r" % ptype)
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx, ins, attrs):
+    """Softmax within each sequence (reference:
+    sequence_softmax_op.cc; X is [T, 1])."""
+    x = ins["X"][0]
+    seg, _, valid = _seg_pos(x)
+    B = x.nseq()
+    v = jnp.reshape(x.values, (-1,))
+    v = jnp.where(valid, v, -jnp.inf)
+    seg_s = jnp.where(valid, seg, B)
+    mx = jax.ops.segment_max(v, seg_s, num_segments=B + 1)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    e = jnp.where(valid, jnp.exp(v - mx[seg]), 0.0)
+    denom = jax.ops.segment_sum(e, seg_s, num_segments=B + 1)
+    out = e / jnp.maximum(denom[seg], 1e-12)
+    out = jnp.where(valid, out, 0.0)
+    return {"Out": [x.with_values(out.reshape(x.values.shape))]}
+
+
+@register_op("sequence_conv")
+def sequence_conv(ctx, ins, attrs):
+    """Context-window conv along each sequence (reference:
+    sequence_conv_op.cc + math/context_project.h)."""
+    x = ins["X"][0]
+    filt = ins["Filter"][0]  # [ctx_len*D, M]
+    ctx_start = int(attrs.get("contextStart", -1))
+    ctx_len = int(attrs.get("contextLength", 3))
+    seg, inseq, valid = _seg_pos(x)
+    T, D = x.values.shape
+    lens = x.seq_lengths()
+    cols = []
+    for j in range(ctx_len):
+        off = ctx_start + j
+        src = jnp.clip(jnp.arange(T, dtype=jnp.int32) + off, 0, T - 1)
+        in_same_seq = (inseq + off >= 0) & (inseq + off < lens[seg])
+        v = x.values[src]
+        v = jnp.where((in_same_seq & valid)[:, None], v, 0.0)
+        cols.append(v)
+    ctx_mat = jnp.concatenate(cols, axis=1)  # [T, ctx_len*D]
+    out = jnp.dot(ctx_mat, filt)
+    return {"Out": [x.with_values(out)]}
+
+
+@register_op("row_conv")
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference: row_conv_op.cc)."""
+    x = ins["X"][0]
+    filt = ins["Filter"][0]  # [future+1, D]
+    k = filt.shape[0]
+    seg, inseq, valid = _seg_pos(x)
+    T = x.values.shape[0]
+    lens = x.seq_lengths()
+    out = jnp.zeros_like(x.values)
+    for j in range(k):
+        src = jnp.clip(jnp.arange(T, dtype=jnp.int32) + j, 0, T - 1)
+        ok = (inseq + j < lens[seg]) & valid
+        out = out + jnp.where(ok[:, None], x.values[src] * filt[j][None],
+                              0.0)
+    return {"Out": [x.with_values(out)]}
+
+
+@register_op("sequence_expand")
+def sequence_expand(ctx, ins, attrs):
+    """Repeat each row/sequence of X per Y's lod (reference:
+    sequence_expand_op.cc).  X row i is tiled over Y's i-th sequence."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    seg, inseq, valid = _seg_pos(y, level=0)
+    xv = x.values if isinstance(x, RaggedTensor) else x
+    if isinstance(x, RaggedTensor):
+        # expand whole sequences: x seq i maps to y seq i positions
+        xs = x.last_splits()
+        src = jnp.clip(xs[seg] + inseq, 0, xv.shape[0] - 1)
+        out_vals = xv[src]
+    else:
+        out_vals = xv[seg]
+    out_vals = jnp.where(
+        valid.reshape((-1,) + (1,) * (out_vals.ndim - 1)), out_vals, 0.0)
+    return {"Out": [RaggedTensor(out_vals, y.row_splits, y.nvalid)]}
+
+
+def _concat_time_pair(a, b):
+    """Per-example time concat of two lod_level-1 ragged tensors via one
+    gather: out[i] = a[i] ++ b[i]."""
+    rs_a, rs_b = a.row_splits[-1], b.row_splits[-1]
+    nseq = rs_a.shape[0] - 1
+    la = rs_a[1:] - rs_a[:-1]
+    lb = rs_b[1:] - rs_b[:-1]
+    out_splits = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(la + lb).astype(jnp.int32)])
+    n_out = a.values.shape[0] + b.values.shape[0]  # static buffer size
+    pos = jnp.arange(n_out, dtype=jnp.int32)
+    seg = jnp.clip(
+        jnp.searchsorted(out_splits, pos, side="right").astype(jnp.int32)
+        - 1, 0, nseq - 1)
+    off = pos - out_splits[seg]
+    from_a = off < la[seg]
+    src = jnp.where(from_a, rs_a[seg] + off,
+                    a.values.shape[0] + rs_b[seg] + (off - la[seg]))
+    allvals = jnp.concatenate([a.values, b.values], axis=0)
+    vals = allvals[jnp.clip(src, 0, n_out - 1)]
+    return RaggedTensor(vals, [out_splits], nvalid=a.nvalid + b.nvalid)
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx, ins, attrs):
+    """Concat along time (axis=0, per-example sequence append) or the
+    feature axis (axis=1) (reference: sequence_concat_op.cc)."""
+    xs = ins["X"]
+    axis = int(attrs.get("axis", 0))
+    if axis == 1:
+        vals = jnp.concatenate([x.values for x in xs], axis=1)
+        return {"Out": [xs[0].with_values(vals)]}
+    out = xs[0]
+    for x in xs[1:]:
+        out = _concat_time_pair(out, x)
+    return {"Out": [out]}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    new_dim = int(attrs["new_dim"])
+    T, D = x.values.shape
+    factor = D / new_dim
+    vals = x.values.reshape(-1, new_dim)
+    rs = [(r.astype(jnp.float32) * factor).astype(jnp.int32)
+          for r in x.row_splits]
+    nvalid = (x.nvalid.astype(jnp.float32) * factor).astype(jnp.int32)
+    return {"Out": [RaggedTensor(vals, rs, nvalid)]}
+
+
+@register_op("sequence_slice")
+def sequence_slice(ctx, ins, attrs):
+    """Slice [offset, offset+length) from each sequence (reference:
+    sequence_slice_op.cc).  Output keeps the flat buffer size; lengths
+    shrink (rows beyond become padding)."""
+    x = ins["X"][0]
+    offset = jnp.reshape(ins["Offset"][0], (-1,)).astype(jnp.int32)
+    length = jnp.reshape(ins["Length"][0], (-1,)).astype(jnp.int32)
+    T = x.values.shape[0]
+    new_splits = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(length)])
+    nseq = x.nseq()
+    pos = jnp.arange(T, dtype=jnp.int32)
+    new_seg = jnp.clip(
+        jnp.searchsorted(new_splits, pos, side="right") - 1, 0, nseq - 1)
+    new_in = pos - new_splits[new_seg]
+    old_rs = x.last_splits()
+    src = jnp.clip(old_rs[new_seg] + offset[new_seg] + new_in, 0, T - 1)
+    vals = x.values[src]
+    nvalid = new_splits[-1]
+    valid = pos < nvalid
+    vals = jnp.where(valid.reshape((-1,) + (1,) * (vals.ndim - 1)), vals,
+                     0.0)
+    return {"Out": [RaggedTensor(vals, [new_splits], nvalid)]}
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse the rows within each sequence (reference:
+    RecurrentLayerGroup reversed inlinks; later sequence_reverse_op).
+    Gather through the mirrored in-sequence position — pure jax, same
+    splits out."""
+    x = ins["X"][0]
+    seg, inseq, valid = _seg_pos(x)
+    rs = x.last_splits()
+    lengths = rs[1:] - rs[:-1]
+    src = rs[seg] + lengths[seg] - 1 - inseq
+    src = jnp.clip(src, 0, x.values.shape[0] - 1)
+    vals = jnp.where(
+        valid.reshape((-1,) + (1,) * (x.values.ndim - 1)),
+        x.values[src], jnp.zeros_like(x.values))
+    return {"Y": [RaggedTensor(vals, x.row_splits, x.nvalid)]}
+
+
+@register_op("lod_reset")
+def lod_reset(ctx, ins, attrs):
+    x = ins["X"][0]
+    xv = x.values if isinstance(x, RaggedTensor) else x
+    if "TargetLoD" in ins and ins["TargetLoD"]:
+        target = jnp.reshape(ins["TargetLoD"][0], (-1,)).astype(jnp.int32)
+    else:
+        target = jnp.asarray(np.asarray(attrs["target_lod"], np.int32))
+    return {"Out": [RaggedTensor(xv, [target])]}
+
+
+# ---------------------------------------------------------------------------
+# Recurrent cells: dynamic LSTM / GRU over ragged input
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+@register_op("lstm")
+def lstm(ctx, ins, attrs):
+    """Dynamic LSTM over a ragged batch (reference: lstm_op.cc +
+    math/lstm_compute.h; gate order i, f, c, o).  The reference reorders
+    sequences into time-major batches (sequence2batch); here we pad to
+    [B, maxT] and lax.scan over time with per-step masks — the whole
+    recurrence compiles to one fused XLA while-loop and differentiates
+    via jax.vjp."""
+    x = ins["Input"][0]             # ragged [T, 4D] (pre-projected)
+    w = ins["Weight"][0]            # [D, 4D]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    use_peepholes = attrs.get("use_peepholes", True)
+    act_g = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    act_c = _ACTS[attrs.get("cell_activation", "tanh")]
+    act_h = _ACTS[attrs.get("candidate_activation", "tanh")]
+    is_reverse = attrs.get("is_reverse", False)
+
+    D = w.shape[0]
+    padded, lens = ragged_to_padded(x)      # [B, T, 4D]
+    B, T = padded.shape[0], padded.shape[1]
+    if is_reverse:
+        # reverse each sequence in time (respecting its length)
+        t_idx = jnp.arange(T)[None, :]
+        rev = jnp.clip(lens[:, None] - 1 - t_idx, 0, T - 1)
+        padded = jnp.take_along_axis(padded, rev[..., None], axis=1)
+
+    bias_g = None
+    peep = None
+    if b is not None:
+        bflat = jnp.reshape(b, (-1,))
+        bias_g = bflat[: 4 * D]
+        if use_peepholes and bflat.shape[0] >= 7 * D:
+            peep = (bflat[4 * D:5 * D], bflat[5 * D:6 * D],
+                    bflat[6 * D:7 * D])  # Wic, Wif, Woc
+
+    # the recurrence carries are f32 even under FLAGS_amp_bf16_act: the
+    # cell state accumulates across T steps (bf16 would compound rounding
+    # error), and bias/peephole params are f32 so the gate math promotes
+    # to f32 anyway; _amp_dot still feeds the MXU bf16 operands.  The
+    # ragged outputs drop back to the activation dtype below.
+    state_dtype = jnp.float32 if padded.dtype == jnp.bfloat16 \
+        else padded.dtype
+    h0 = (ins["H0"][0] if "H0" in ins
+          else jnp.zeros((B, D))).astype(state_dtype)
+    c0 = (ins["C0"][0] if "C0" in ins
+          else jnp.zeros((B, D))).astype(state_dtype)
+
+    xs = jnp.swapaxes(padded, 0, 1)          # [T, B, 4D]
+    mask_t = (jnp.arange(T)[:, None] < lens[None, :]).astype(state_dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m = inp
+        gates = x_t + _amp_dot(h_prev, w)
+        if bias_g is not None:
+            gates = gates + bias_g[None, :]
+        gi = gates[:, :D]
+        gf = gates[:, D:2 * D]
+        gc = gates[:, 2 * D:3 * D]
+        go = gates[:, 3 * D:]
+        if peep is not None:
+            gi = gi + peep[0][None, :] * c_prev
+            gf = gf + peep[1][None, :] * c_prev
+        i = act_g(gi)
+        f = act_g(gf)
+        c_tilde = act_c(gc)
+        c = f * c_prev + i * c_tilde
+        if peep is not None:
+            go = go + peep[2][None, :] * c
+        o = act_g(go)
+        h = o * act_h(c)
+        m1 = m[:, None]
+        h = m1 * h + (1 - m1) * h_prev
+        c = m1 * c + (1 - m1) * c_prev
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xs, mask_t))
+    hs = jnp.swapaxes(hs, 0, 1)              # [B, T, D]
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        t_idx = jnp.arange(T)[None, :]
+        rev = jnp.clip(lens[:, None] - 1 - t_idx, 0, T - 1)
+        hs = jnp.take_along_axis(hs, rev[..., None], axis=1)
+        cs = jnp.take_along_axis(cs, rev[..., None], axis=1)
+
+    like = RaggedTensor(jnp.zeros((x.values.shape[0], D), x.values.dtype),
+                        x.row_splits, x.nvalid)
+    hidden = padded_to_ragged(hs.astype(x.values.dtype), like)
+    cell = padded_to_ragged(cs.astype(x.values.dtype), like)
+    return {"Hidden": [hidden], "Cell": [cell],
+            "BatchGate": [x], "BatchCellPreAct": [cell]}
+
+
+@register_op("gru")
+def gru(ctx, ins, attrs):
+    """Dynamic GRU (reference: gru_op.cc + math/gru_compute; gate layout
+    [update u, reset r, candidate c])."""
+    x = ins["Input"][0]             # ragged [T, 3D]
+    w = ins["Weight"][0]            # [D, 3D]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    act_g = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    act_c = _ACTS[attrs.get("activation", "tanh")]
+    is_reverse = attrs.get("is_reverse", False)
+
+    D = w.shape[0]
+    w_ur = w[:, : 2 * D]
+    w_c = w[:, 2 * D:]
+    padded, lens = ragged_to_padded(x)
+    B, T = padded.shape[0], padded.shape[1]
+    if is_reverse:
+        t_idx = jnp.arange(T)[None, :]
+        rev = jnp.clip(lens[:, None] - 1 - t_idx, 0, T - 1)
+        padded = jnp.take_along_axis(padded, rev[..., None], axis=1)
+    if b is not None:
+        padded = padded + jnp.reshape(b, (1, 1, -1))
+
+    # f32 recurrence state under FLAGS_amp_bf16_act (see lstm above)
+    state_dtype = jnp.float32 if x.values.dtype == jnp.bfloat16 \
+        else x.values.dtype
+    h0 = (ins["H0"][0] if "H0" in ins
+          else jnp.zeros((B, D))).astype(state_dtype)
+    xs = jnp.swapaxes(padded, 0, 1)
+    mask_t = (jnp.arange(T)[:, None] < lens[None, :]).astype(state_dtype)
+
+    def step(h_prev, inp):
+        x_t, m = inp
+        ur = act_g(x_t[:, :2 * D].astype(state_dtype) +
+                   _amp_dot(h_prev, w_ur))
+        u, r = ur[:, :D], ur[:, D:]
+        c = act_c(x_t[:, 2 * D:].astype(state_dtype) +
+                  _amp_dot(r * h_prev, w_c))
+        h = u * h_prev + (1 - u) * c
+        m1 = m[:, None]
+        h = m1 * h + (1 - m1) * h_prev
+        return h, h
+
+    _, hs = lax.scan(step, h0, (xs, mask_t))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        t_idx = jnp.arange(T)[None, :]
+        rev = jnp.clip(lens[:, None] - 1 - t_idx, 0, T - 1)
+        hs = jnp.take_along_axis(hs, rev[..., None], axis=1)
+    like = RaggedTensor(jnp.zeros((x.values.shape[0], D), x.values.dtype),
+                        x.row_splits, x.nvalid)
+    hidden = padded_to_ragged(hs.astype(x.values.dtype), like)
+    return {"Hidden": [hidden], "BatchGate": [x],
+            "BatchResetHiddenPrev": [hidden], "BatchHidden": [hidden]}
+
+
+@register_op("gru_unit")
+def gru_unit(ctx, ins, attrs):
+    """Single GRU step on dense tensors (reference: gru_unit_op.cc)."""
+    x = ins["Input"][0]             # [N, 3D]
+    h_prev = ins["HiddenPrev"][0]   # [N, D]
+    w = ins["Weight"][0]            # [D, 3D]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    act_g = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    act_c = _ACTS[attrs.get("activation", "tanh")]
+    D = h_prev.shape[1]
+    if b is not None:
+        x = x + jnp.reshape(b, (1, -1))
+    ur = act_g(x[:, :2 * D] + _amp_dot(h_prev, w[:, :2 * D]))
+    u, r = ur[:, :D], ur[:, D:]
+    c = act_c(x[:, 2 * D:] + _amp_dot(r * h_prev, w[:, 2 * D:]))
+    h = u * h_prev + (1 - u) * c
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return {"Gate": [gate], "ResetHiddenPrev": [r * h_prev], "Hidden": [h]}
+
+
+@register_op("sequence_to_dense")
+def sequence_to_dense(ctx, ins, attrs):
+    """Ragged [T, ...] -> padded dense [B, maxT, ...] + float mask [B, maxT].
+    The bridge from LoD-world into the scan-based `recurrent` engine
+    (replaces reference operators/math/sequence2batch.h's reordering)."""
+    x = ins["X"][0]
+    padded, lens = ragged_to_padded(x)
+    T = padded.shape[1]
+    mask = (jnp.arange(T, dtype=jnp.int32)[None, :]
+            < lens[:, None]).astype(jnp.float32)
+    return {"Out": [padded], "Mask": [mask]}
+
+
+def _sequence_to_dense_infer(block, op_desc):
+    from ..fluid.framework import _find_var_desc
+
+    xv = _find_var_desc(block, op_desc.input("X")[0])
+    out = _find_var_desc(block, op_desc.output("Out")[0])
+    mask = _find_var_desc(block, op_desc.output("Mask")[0])
+    out.shape = (-1, -1) + tuple(xv.shape[1:] if xv.shape else ())
+    out.dtype = xv.dtype
+    out.lod_level = 0
+    mask.shape = (-1, -1)
+    mask.dtype = "float32"
+    mask.lod_level = 0
+
+
+from .registry import get_op_info as _gi_seq
+
+_gi_seq("sequence_to_dense").infer_shape = _sequence_to_dense_infer
+
+
+def _sequence_reshape_infer(block, op_desc):
+    # generic eval_shape priming uses a prime row count that need not be
+    # divisible by new_dim; the true output is [-1, new_dim]
+    from ..fluid.framework import _find_var_desc
+
+    xv = _find_var_desc(block, op_desc.input("X")[0])
+    out = _find_var_desc(block, op_desc.output("Out")[0])
+    out.shape = (-1, int(op_desc.attrs["new_dim"]))
+    out.dtype = xv.dtype
+    out.lod_level = max(xv.lod_level or 0, 1)
+
+
+_gi_seq("sequence_reshape").infer_shape = _sequence_reshape_infer
+
+
+@register_op("dense_to_sequence")
+def dense_to_sequence(ctx, ins, attrs):
+    """Padded dense [B, maxT, ...] -> ragged with Like's row splits."""
+    x = ins["X"][0]
+    like = ins["Like"][0]
+    tpl = RaggedTensor(
+        jnp.zeros((like.values.shape[0],) + tuple(x.shape[2:]), x.dtype),
+        like.row_splits, like.nvalid)
+    return {"Out": [padded_to_ragged(x, tpl)]}
+
+
+def _dense_to_sequence_infer(block, op_desc):
+    from ..fluid.framework import _find_var_desc
+
+    xv = _find_var_desc(block, op_desc.input("X")[0])
+    like = _find_var_desc(block, op_desc.input("Like")[0])
+    out = _find_var_desc(block, op_desc.output("Out")[0])
+    out.shape = (-1,) + tuple(xv.shape[2:] if xv.shape else ())
+    out.dtype = xv.dtype
+    out.lod_level = like.lod_level
+
+
+_gi_seq("dense_to_sequence").infer_shape = _dense_to_sequence_infer
+
+
+# -- nested (lod_level 2) sequence machinery ---------------------------------
+# The RecurrentGradientMachine's nested-sequence mode (reference:
+# RecurrentGradientMachine.h:32, layers.py SubsequenceInput:4067) is
+# lowered by FLATTENING: the outer "loop over subsequences" becomes a
+# batch axis (every inner sequence is an independent lod-1 sequence),
+# computation runs once over the whole sentence batch, and the outer
+# row_splits are reattached afterwards.  All three ops are pure splits
+# bookkeeping -- jittable, differentiable pass-throughs for the values.
+
+@register_op("seq_unnest")
+def seq_unnest(ctx, ins, attrs):
+    """lod-2 nested sequence -> (lod-1 batch of inner sequences,
+    OuterRef carrying the dropped outer row_splits over inner rows)."""
+    x = ins["X"][0]
+    if not isinstance(x, RaggedTensor) or x.lod_level < 2:
+        raise ValueError("seq_unnest needs a lod_level-2 input")
+    outer, inner = x.row_splits[0], x.row_splits[-1]
+    n_inner = inner.shape[0] - 1
+    inner_batch = RaggedTensor(x.values, [inner], x.nvalid)
+    outer_ref = RaggedTensor(jnp.zeros((n_inner, 1), jnp.float32),
+                             [outer], n_inner)
+    return {"Inner": [inner_batch], "OuterRef": [outer_ref]}
+
+
+@register_op("seq_outer_expand", nondiff_inputs=("OuterRef",))
+def seq_outer_expand(ctx, ins, attrs):
+    """Tile per-sample rows to per-inner-sequence rows: out[s] =
+    X[sample_of(s)] -- the flattened analog of a StaticInput entering
+    every outer step."""
+    x = ins["X"][0]
+    ref = ins["OuterRef"][0]
+    xv = x.values if isinstance(x, RaggedTensor) else x
+    seg = ref.segment_ids(level=-1)
+    return {"Out": [xv[seg]]}
+
+
+@register_op("seq_renest", nondiff_inputs=("OuterRef",))
+def seq_renest(ctx, ins, attrs):
+    """Reattach the outer row_splits to a flattened result.  Dense
+    [n_inner, D] rows -> lod-1 sequence over samples; a lod-1 ragged
+    (per-inner-sequence steps) -> the full lod-2 nested sequence."""
+    x = ins["X"][0]
+    ref = ins["OuterRef"][0]
+    outer = ref.row_splits[0]
+    rows = (x.last_splits().shape[0] - 1 if isinstance(x, RaggedTensor)
+            else x.shape[0])
+    try:  # fail fast in eager mode; outer[-1] is a tracer under jit
+        expected = int(outer[-1])
+    except Exception:
+        expected = None
+    if expected is not None and expected != rows:
+        raise ValueError(
+            "seq_renest: step output has %d %s but the outer splits "
+            "cover %d inner sequences — the nested step must produce "
+            "one row (or one sequence) per subsequence"
+            % (rows, "sequences" if isinstance(x, RaggedTensor)
+               else "rows", expected))
+    if isinstance(x, RaggedTensor):
+        return {"Out": [RaggedTensor(x.values,
+                                     [outer, x.last_splits()],
+                                     x.nvalid)]}
+    return {"Out": [RaggedTensor(x, [outer])]}

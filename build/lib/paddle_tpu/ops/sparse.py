@@ -1,0 +1,81 @@
+"""Embedding / sparse op kernels.
+
+TPU-native equivalents of reference ops (paddle/operators/
+lookup_table_op.cc — the CTR/sparse-update workhorse with dense and
+SelectedRows gradients, split_selected_rows_op.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_kernel
+from ..core.ragged import RaggedTensor, SelectedRows
+
+
+@register_op("lookup_table", nondiff_inputs=("Ids",),
+             sparse_grad_slots=lambda attrs:
+                 ("W",) if attrs.get("is_sparse") else ())
+def lookup_table(ctx, ins, attrs):
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    ragged = isinstance(ids, RaggedTensor)
+    idv = ids.values if ragged else ids
+    flat = jnp.reshape(idv, (-1,)).astype(jnp.int32)
+    padding_idx = int(attrs.get("padding_idx", -1))
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx >= 0:
+        out = jnp.where((flat == padding_idx)[:, None],
+                        jnp.zeros_like(out), out)
+    if ragged:
+        return {"Out": [ids.with_values(out)]}
+    # keep leading dims of ids, append emb dim
+    lead = idv.shape[:-1] if idv.ndim > 1 and idv.shape[-1] == 1 \
+        else idv.shape
+    return {"Out": [out.reshape(tuple(lead) + (w.shape[1],))]}
+
+
+@register_grad_kernel("lookup_table")
+def lookup_table_grad(ctx, ins, attrs):
+    """Sparse path returns a SelectedRows gradient (reference:
+    lookup_table_op.cc LookupTableGradKernel, is_sparse attr) — the
+    optimizer ops then scatter-update only the touched rows."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    og = ins["OG@Out"][0]
+    ragged = isinstance(ids, RaggedTensor)
+    idv = ids.values if ragged else ids
+    flat_ids = jnp.reshape(idv, (-1,)).astype(jnp.int32)
+    g = og.values if isinstance(og, RaggedTensor) else og
+    flat_g = jnp.reshape(g, (-1, w.shape[1]))
+    padding_idx = int(attrs.get("padding_idx", -1))
+    if padding_idx >= 0:
+        flat_g = jnp.where((flat_ids == padding_idx)[:, None],
+                           jnp.zeros_like(flat_g), flat_g)
+    if ragged:
+        # zero out padded rows beyond nvalid
+        mask = ids.valid_mask()
+        flat_g = jnp.where(mask[:, None], flat_g, 0.0)
+    if attrs.get("is_sparse", False):
+        return {"W@GRAD": [SelectedRows(flat_ids, flat_g, w.shape[0])]}
+    dense = jnp.zeros_like(w).at[flat_ids].add(flat_g)
+    return {"W@GRAD": [dense]}
+
+
+@register_op("split_selected_rows", stop_gradient_op=True)
+def split_selected_rows(ctx, ins, attrs):
+    """Partition a SelectedRows by row-id range (reference:
+    split_selected_rows_op.cc; used by the pserver transpiler to shard
+    sparse grads across servers)."""
+    x = ins["X"][0]
+    sections = attrs["height_sections"]
+    outs = []
+    start = 0
+    for h in sections:
+        in_range = (x.rows >= start) & (x.rows < start + h)
+        # static shapes: keep all rows, zero the out-of-range ones and
+        # rebase ids (rows out of range point at row 0 with zero values)
+        rows = jnp.where(in_range, x.rows - start, 0)
+        vals = jnp.where(in_range[:, None], x.values, 0.0)
+        outs.append(SelectedRows(rows, vals, h))
+        start += h
+    return {"Out": outs}

@@ -1,0 +1,250 @@
+"""Kernels completing the v2 layer zoo (reference:
+paddle/gserver/layers/*.cpp behaviors exposed through
+trainer_config_helpers/layers.py — hsigmoid, bilinear_interp,
+sampling_id, kmax_seq_score, sub_nested_seq, scale_sub_region,
+lambda_cost, cross_entropy selfnorm/multi-binary variants, rotate,
+out_prod, linear_comb).
+
+All dense kernels are pure JAX (jit-fused); ragged selectors that
+restructure LoD are host ops like the rest of the sequence family.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..core.ragged import RaggedTensor
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx, ins, attrs):
+    """reference: bilinear_interp_op.cc / BilinearInterpLayer.cpp —
+    NCHW bilinear resize, lowered to jax.image.resize."""
+    x = ins["X"][0]
+    out_h = int(attrs["out_h"])
+    out_w = int(attrs["out_w"])
+    n, c = x.shape[0], x.shape[1]
+    out = jax.image.resize(x, (n, c, out_h, out_w), method="bilinear")
+    return {"Out": [out.astype(x.dtype)]}
+
+
+def _hsigmoid_paths(num_classes, labels):
+    """Complete-binary-tree bit codes (reference: MatrixBitCodeFunctor,
+    matrix_bit_code.h).  Returns (node index [B, L], bit [B, L],
+    mask [B, L]) with L = max path length."""
+    code = labels + num_classes                  # leaves start at 2^?
+    max_len = int(np.ceil(np.log2(max(num_classes, 2))))
+    lengths = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(
+        jnp.int32)
+    js = jnp.arange(max_len, dtype=jnp.int32)
+    valid = js[None, :] < lengths[:, None]
+    shift_idx = lengths[:, None] - js[None, :]
+    idx = (code[:, None] >> jnp.maximum(shift_idx, 1)) - 1
+    bit = (code[:, None] >> jnp.maximum(shift_idx - 1, 0)) & 1
+    idx = jnp.clip(idx, 0, num_classes - 2)
+    return idx, bit.astype(jnp.float32), valid.astype(jnp.float32)
+
+
+@register_op("hsigmoid", nondiff_inputs=("Label",))
+def hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid cost over a complete binary tree
+    (reference: hierarchical_sigmoid_op / HierarchicalSigmoidLayer.cpp).
+    cost = sum_path log(1 + exp(x)) - bit * x, x = w_node . input + b."""
+    x = ins["X"][0]                              # [B, D]
+    w = ins["W"][0]                              # [num_classes-1, D]
+    label = jnp.reshape(ins["Label"][0], (-1,)).astype(jnp.int32)
+    bias = ins.get("Bias", [None])[0]            # [1, num_classes-1]
+    num_classes = int(attrs["num_classes"])
+
+    idx, bit, mask = _hsigmoid_paths(num_classes, label)   # [B, L]
+    w_path = w[idx]                              # [B, L, D]
+    logits = jnp.einsum("bld,bd->bl", w_path, x)
+    if bias is not None:
+        logits = logits + jnp.reshape(bias, (-1,))[idx]
+    # log(1+e^x) - bit*x, numerically stable softplus
+    cost = (jax.nn.softplus(logits) - bit * logits) * mask
+    return {"Out": [jnp.sum(cost, axis=1, keepdims=True)]}
+
+
+@register_op("sampling_id", stop_gradient_op=True, uses_rng=True)
+def sampling_id(ctx, ins, attrs):
+    """Sample one id per row from a probability matrix (reference:
+    SamplingIdLayer.cpp)."""
+    p = ins["X"][0]
+    key = ctx.next_rng()
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    ids = jax.random.categorical(key, logits, axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register_op("kmax_seq_score", stop_gradient_op=True, jittable=False)
+def kmax_seq_score(ctx, ins, attrs):
+    """Top-k score indices within each sequence (reference:
+    KmaxSeqScoreLayer.cpp).  Output: int32 sequence of k (or fewer)
+    in-sequence indices per input sequence."""
+    x = ins["X"][0]
+    k = int(attrs["beam_size"])
+    vals = np.asarray(x.values).reshape(-1)
+    splits = np.asarray(x.last_splits())
+    out_rows, out_splits = [], [0]
+    for i in range(len(splits) - 1):
+        seg = vals[splits[i]:splits[i + 1]]
+        kk = min(k, len(seg))
+        top = np.argsort(-seg, kind="stable")[:kk]
+        out_rows.append(top.astype(np.int32))
+        out_splits.append(out_splits[-1] + kk)
+    flat = (np.concatenate(out_rows) if out_rows
+            else np.zeros((0,), np.int32)).reshape(-1, 1)
+    return {"Out": [RaggedTensor(jnp.asarray(flat),
+                                 [np.asarray(out_splits, np.int32)])]}
+
+
+@register_op("sub_nested_seq", stop_gradient_op=True, jittable=False)
+def sub_nested_seq(ctx, ins, attrs):
+    """Select inner sequences of a nested (lod_level 2) sequence by
+    per-sample indices (reference: SubNestedSequenceLayer.cpp)."""
+    x = ins["X"][0]
+    sel = ins["S"][0]
+    outer = np.asarray(x.row_splits[0])
+    inner = np.asarray(x.row_splits[-1])
+    vals = np.asarray(x.values)
+    sel_vals = np.asarray(sel.values).reshape(-1).astype(np.int64)
+    sel_splits = np.asarray(sel.last_splits())
+
+    segs, splits = [], [0]
+    for b in range(len(outer) - 1):
+        picks = sel_vals[sel_splits[b]:sel_splits[b + 1]]
+        for j in picks:
+            ii = outer[b] + int(j)
+            seg = vals[inner[ii]:inner[ii + 1]]
+            segs.append(seg)
+            splits.append(splits[-1] + len(seg))
+    flat = np.concatenate(segs, 0) if segs else vals[:0]
+    return {"Out": [RaggedTensor(jnp.asarray(flat),
+                                 [np.asarray(splits, np.int32)])]}
+
+
+@register_op("scale_sub_region", nondiff_inputs=("Indices",))
+def scale_sub_region(ctx, ins, attrs):
+    """Scale a per-sample [C,H,W] sub-region by `value` (reference:
+    ScaleSubRegionLayer.cpp / scale_sub_region_op).  Indices rows are
+    1-based [c0, c1, h0, h1, w0, w1] inclusive ranges."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0].astype(jnp.int32)
+    value = jnp.asarray(attrs.get("value", 1.0), x.dtype)
+    _, C, H, W = x.shape
+    c = jnp.arange(C, dtype=jnp.int32)
+    h = jnp.arange(H, dtype=jnp.int32)
+    w = jnp.arange(W, dtype=jnp.int32)
+    in_c = (c[None, :] >= idx[:, 0:1] - 1) & (c[None, :] <= idx[:, 1:2] - 1)
+    in_h = (h[None, :] >= idx[:, 2:3] - 1) & (h[None, :] <= idx[:, 3:4] - 1)
+    in_w = (w[None, :] >= idx[:, 4:5] - 1) & (w[None, :] <= idx[:, 5:6] - 1)
+    mask = (in_c[:, :, None, None] & in_h[:, None, :, None] &
+            in_w[:, None, None, :])
+    return {"Out": [jnp.where(mask, x * value, x)]}
+
+
+@register_op("lambda_cost", nondiff_inputs=("Label",))
+def lambda_cost(ctx, ins, attrs):
+    """LambdaRank listwise cost over each sequence (reference:
+    LambdaCost.cpp): pairwise logistic loss weighted by |delta NDCG|
+    truncated at NDCG_num."""
+    from .sequence import _seg_pos
+
+    score = ins["Score"][0]
+    label = ins["Label"][0]
+    ndcg_num = int(attrs.get("NDCG_num", 5))
+    s = jnp.reshape(score.values, (-1,))
+    y = jnp.reshape(label.values, (-1,)).astype(jnp.float32)
+    seg, inseq, valid = _seg_pos(score)
+    T = s.shape[0]
+
+    same = (seg[:, None] == seg[None, :]) & valid[:, None] & valid[None, :]
+    # ideal DCG per sequence from sorted labels (approximate via rank of
+    # each item's label within its sequence by value ordering)
+    gain = (jnp.power(2.0, y) - 1.0)
+    disc_pos = 1.0 / jnp.log2(2.0 + inseq.astype(jnp.float32))
+    dcg_w = jnp.where(inseq < ndcg_num, disc_pos, 0.0)
+    # |delta NDCG| for swapping i,j approximated with position discounts
+    dw = jnp.abs(gain[:, None] - gain[None, :]) * \
+        jnp.abs(dcg_w[:, None] - dcg_w[None, :])
+    diff = s[:, None] - s[None, :]
+    pair_loss = jax.nn.softplus(-diff)           # log(1+e^{-(si-sj)})
+    rel = (y[:, None] > y[None, :]) & same
+    loss_mat = jnp.where(rel, dw * pair_loss, 0.0)
+    per_item = jnp.sum(loss_mat, axis=1, keepdims=True)
+    return {"Out": [RaggedTensor(per_item, score.row_splits,
+                                 score.nvalid)]}
+
+
+@register_op("cross_entropy_selfnorm", nondiff_inputs=("Label",))
+def cross_entropy_selfnorm(ctx, ins, attrs):
+    """CE plus alpha * ln(Z)^2 self-normalization (reference:
+    CostLayer.cpp CrossEntropyWithSelfNorm)."""
+    p = ins["X"][0]
+    pv = p.values if isinstance(p, RaggedTensor) else p
+    label = ins["Label"][0]
+    lv = label.values if isinstance(label, RaggedTensor) else label
+    lv = jnp.reshape(lv, (-1,)).astype(jnp.int32)
+    alpha = float(attrs.get("softmax_selfnorm_alpha", 0.1))
+    z = jnp.sum(pv, axis=1)
+    picked = pv[jnp.arange(pv.shape[0]), lv]
+    cost = -jnp.log(jnp.maximum(picked / jnp.maximum(z, 1e-30), 1e-30))
+    cost = cost + alpha * jnp.square(jnp.log(jnp.maximum(z, 1e-30)))
+    cost = cost[:, None]
+    if isinstance(p, RaggedTensor):
+        return {"Out": [RaggedTensor(cost, p.row_splits, p.nvalid)]}
+    return {"Out": [cost]}
+
+
+@register_op("multi_binary_label_cross_entropy",
+             nondiff_inputs=("Label",))
+def multi_binary_label_cross_entropy(ctx, ins, attrs):
+    """Multi-label binary CE on probabilities (reference: CostLayer.cpp
+    MultiBinaryLabelCrossEntropy)."""
+    p = ins["X"][0]
+    pv = p.values if isinstance(p, RaggedTensor) else p
+    y = ins["Label"][0]
+    yv = (y.values if isinstance(y, RaggedTensor) else y).astype(
+        pv.dtype)
+    eps = 1e-8
+    cost = -(yv * jnp.log(pv + eps) + (1 - yv) * jnp.log(1 - pv + eps))
+    out = jnp.sum(cost, axis=1, keepdims=True)
+    if isinstance(p, RaggedTensor):
+        return {"Out": [RaggedTensor(out, p.row_splits, p.nvalid)]}
+    return {"Out": [out]}
+
+
+@register_op("rotate")
+def rotate(ctx, ins, attrs):
+    """Rotate each [C, H, W] feature map 90 degrees counter-clockwise
+    (reference: RotateLayer.cpp).  Input arrives flattened [B, C*H*W]."""
+    x = ins["X"][0]
+    c, h, w = (int(attrs["channels"]), int(attrs["height"]),
+               int(attrs["width"]))
+    maps = jnp.reshape(x, (-1, c, h, w))
+    rot = jnp.flip(jnp.swapaxes(maps, 2, 3), axis=2)   # ccw 90
+    return {"Out": [jnp.reshape(rot, (x.shape[0], -1))]}
+
+
+@register_op("out_prod")
+def out_prod(ctx, ins, attrs):
+    """Row-wise outer product, flattened (reference:
+    OuterProdLayer.cpp)."""
+    a, b = ins["X"][0], ins["Y"][0]
+    out = jnp.einsum("bi,bj->bij", a, b)
+    return {"Out": [jnp.reshape(out, (a.shape[0], -1))]}
+
+
+@register_op("linear_comb")
+def linear_comb(ctx, ins, attrs):
+    """out = sum_k w[:, k] * x[:, k*size:(k+1)*size] (reference:
+    LinearChainCombLayer / ConvexCombinationLayer.cpp)."""
+    x = ins["X"][0]
+    w = ins["W"][0]
+    size = int(attrs["size"])
+    k = w.shape[1]
+    xs = jnp.reshape(x, (x.shape[0], k, size))
+    return {"Out": [jnp.einsum("bk,bks->bs", w, xs)]}

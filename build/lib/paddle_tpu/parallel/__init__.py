@@ -1,0 +1,37 @@
+"""Multi-chip parallel training over a jax.sharding.Mesh.
+
+TPU-native replacement for the reference's data/model parallel machinery:
+  * MultiGradientMachine's per-GPU threads + ring gather/scatter
+    (reference: paddle/gserver/gradientmachines/MultiGradientMachine.h:44-83)
+  * parallel_do's LoDTensor split + per-place sub-scopes + NCCL allreduce
+    (reference: paddle/operators/parallel_do_op.cc:112, nccl_op.cc:22-95)
+  * ParallelNeuralNetwork's per-layer device placement
+    (reference: paddle/gserver/gradientmachines/ParallelNeuralNetwork.h)
+
+On TPU none of that is hand-built: we lay the *same program* out over a
+device Mesh with named axes — "dp" (batch/data parallel) and "mp"
+(model/tensor parallel) — annotate parameter and batch shardings, and let
+XLA GSPMD partition the computation and insert the ICI collectives
+(all-reduce/all-gather/reduce-scatter) that replace NCCL and the ring.
+"""
+
+from .mesh import make_mesh, MeshConfig
+from .sharding import (param_spec, batch_spec, shard_state, shard_feeds,
+                       replicated)
+from .trainer import ParallelTrainer, make_parallel_step
+from .ring import ring_attention, ulysses_attention, sp_shard_map
+from .pipeline import (gpipe_spmd, pipeline_apply, split_microbatches,
+                       stack_stage_params)
+from .moe import switch_moe, moe_shard_map, init_moe_params
+from .program_api import (lower_program_fn, PipelineProgramTrainer,
+                          MoEProgramLayer)
+
+__all__ = [
+    "make_mesh", "MeshConfig", "param_spec", "batch_spec", "shard_state",
+    "shard_feeds", "replicated", "ParallelTrainer", "make_parallel_step",
+    "ring_attention", "ulysses_attention", "sp_shard_map",
+    "gpipe_spmd", "pipeline_apply", "split_microbatches",
+    "stack_stage_params", "switch_moe", "moe_shard_map",
+    "init_moe_params", "lower_program_fn", "PipelineProgramTrainer",
+    "MoEProgramLayer",
+]

@@ -1,0 +1,113 @@
+"""Device mesh construction.
+
+Replaces the reference's device enumeration + communicator setup
+(reference: operators/get_places_op.cc, operators/nccl/nccl_gpu_common.h:35
+platform::Communicator, MultiGradientMachine device threads).  A Mesh with
+named axes is the TPU-native "communicator": collectives are implied by
+shardings over its axes and ride ICI.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "MeshConfig"]
+
+
+class MeshConfig:
+    """Axis layout for a training job.
+
+    dp: data parallel (batch) — gradient all-reduce rides this axis.
+    mp: model/tensor parallel — weight shards; matmul partials reduce here.
+    Extended axes (pp pipeline, sp sequence) are carved out of the same
+    device list by callers that need them.
+    """
+
+    def __init__(self, dp=None, mp=1, axes=("dp", "mp")):
+        self.dp = dp
+        self.mp = mp
+        self.axes = tuple(axes)
+
+
+def make_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
+              axes=None, devices=None, drop_unit_axes=False):
+    """Build a Mesh over the five parallelism axes.
+
+    dp defaults to n_devices // (mp*sp*pp*ep).  With mp=1 this is pure
+    data parallelism (the MultiGradientMachine/parallel_do capability);
+    mp>1 shards weights (tensor parallelism), sp shards sequences
+    (ring/Ulysses attention), pp pipelines stages, ep shards experts.
+    By default the mesh keeps the ("dp", "mp") axes even at size 1
+    (back-compat with ParallelTrainer); extended axes appear when
+    requested, and drop_unit_axes=True trims every size-1 axis
+    (at least "dp" always remains).
+    """
+    sizes = {"dp": dp, "mp": mp, "sp": sp, "pp": pp, "ep": ep}
+    if axes is None:
+        axes = ("dp", "mp") if (sp == pp == ep == 1) else tuple(
+            a for a in ("dp", "mp", "sp", "pp", "ep")
+            if a == "dp" or sizes[a] > 1)
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None and len(devices) < n_devices:
+            # asked for more chips than the default platform has (e.g.
+            # a dry run on a host with one real TPU): fall back to the
+            # virtual CPU devices ONLY when the caller deliberately
+            # provisioned enough of them via
+            # xla_force_host_platform_device_count; otherwise this is a
+            # genuine under-provisioning error — say so.
+            try:
+                cpu_devices = jax.devices("cpu")
+            except RuntimeError:  # cpu backend excluded by JAX_PLATFORMS
+                cpu_devices = []
+            if len(cpu_devices) >= n_devices:
+                devices = cpu_devices
+            else:
+                raise ValueError(
+                    "requested a %d-device mesh but only %d %s device(s)"
+                    " are available (and %d virtual CPU devices); set "
+                    "xla_force_host_platform_device_count for a CPU dry "
+                    "run or pass devices= explicitly"
+                    % (n_devices, len(devices), devices[0].platform,
+                       len(cpu_devices)))
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if any(a not in sizes for a in axes):
+        # custom axis NAMES with (dp, mp) semantics, e.g.
+        # axes=("data", "model"): sizes map positionally
+        if len(axes) != 2:
+            raise ValueError("custom axis names are only supported for "
+                             "two-axis (dp, mp)-shaped meshes; got %r"
+                             % (axes,))
+        if sp != 1 or pp != 1 or ep != 1:
+            raise ValueError("sp/pp/ep cannot combine with custom axis "
+                             "names %r" % (axes,))
+        sizes = {axes[0]: dp, axes[1]: mp}
+        dp_name = axes[0]
+    else:
+        dp_name = "dp"
+        dropped = [a for a, s in sizes.items()
+                   if a not in axes and s not in (None, 1)]
+        if dropped:
+            raise ValueError(
+                "axis size(s) %s requested but axes=%r omits them — an "
+                "explicit axes tuple must name every non-unit axis"
+                % ({a: sizes[a] for a in dropped}, tuple(axes)))
+    denom = int(np.prod([sizes[a] for a in axes if a != dp_name]))
+    if dp is None:
+        if n_devices % denom != 0:
+            raise ValueError("n_devices %d not divisible by %d (product "
+                             "of non-dp axes)" % (n_devices, denom))
+        dp = n_devices // denom
+    if dp * denom != n_devices:
+        raise ValueError("axis product (%d*%d) != n_devices %d"
+                         % (dp, denom, n_devices))
+    sizes[dp_name] = dp
+    if drop_unit_axes:
+        # "dp" always survives: batch_spec / trainer / moe default to a
+        # dp axis existing, and a dp=1 axis costs nothing
+        axes = tuple(a for a in axes if sizes[a] > 1 or a == dp_name)
+    dev_array = np.array(devices).reshape([sizes[a] for a in axes])
+    return Mesh(dev_array, axis_names=tuple(axes))

@@ -1,0 +1,162 @@
+"""Expert parallelism: Switch-style top-1 mixture-of-experts over an
+"ep" mesh axis.
+
+No reference counterpart (the 2018 snapshot predates MoE); included
+because expert parallelism is a first-class distributed axis on TPU
+pods.  Design is the standard TPU dispatch/combine einsum pattern:
+tokens pick an expert by router argmax, are packed into per-expert
+capacity slots, shipped to the expert's owner device with
+`lax.all_to_all` over the ICI, transformed by the expert FFN, shipped
+back, and combined weighted by the router probability.  Routing is
+non-differentiable (argmax); gradients flow through the combine
+weights and the expert FFN — exactly the Switch Transformer recipe,
+with its load-balancing auxiliary loss.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .sharding import shard_map_norep
+
+__all__ = ["switch_moe", "moe_shard_map", "init_moe_params"]
+
+
+def init_moe_params(key, d_model, d_hidden, n_experts, dtype=jnp.float32):
+    """Router + stacked expert FFN weights.  The leading n_experts axis
+    of w1/b1/w2/b2 is the one to shard over "ep"."""
+    ks = jax.random.split(jax.random.PRNGKey(key) if isinstance(key, int)
+                          else key, 3)
+    s1 = (2.0 / d_model) ** 0.5
+    s2 = (2.0 / d_hidden) ** 0.5
+    return {
+        "gate_w": jax.random.normal(ks[0], (d_model, n_experts),
+                                    dtype) * s1,
+        "w1": jax.random.normal(ks[1], (n_experts, d_model, d_hidden),
+                                dtype) * s1,
+        "b1": jnp.zeros((n_experts, d_hidden), dtype),
+        "w2": jax.random.normal(ks[2], (n_experts, d_hidden, d_model),
+                                dtype) * s2,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def switch_moe(params, x, axis_name="ep", capacity_factor=1.25,
+               batch_axes=(), expert_fn=None):
+    """Per-device MoE layer; call inside shard_map.
+
+    params: gate_w [d, E] replicated; expert weights with the expert
+    axis "ep"-sharded (local leading dim E/ep) — either the built-in
+    FFN's w1/b1/w2/b2, or, with `expert_fn`, an "experts" pytree of
+    arbitrary structure.  expert_fn(local_expert_params, xin) must map
+    [e_loc, tokens, d] -> [e_loc, tokens, d] (e.g. a vmapped
+    Program-lowered FFN).  x: [b, d] local tokens.  batch_axes: extra
+    mesh axes the tokens shard over (e.g. ("dp",)) so the aux
+    statistics average over ALL token shards.  Returns (y [b, d], aux)
+    — aux is the Switch load-balancing loss
+    (E * sum(fraction_routed * mean_router_prob); ~1 when balanced).
+    """
+    ep = lax.psum(1, axis_name)
+    if expert_fn is None:
+        e_loc = params["w1"].shape[0]
+    else:
+        e_loc = jax.tree_util.tree_leaves(params["experts"])[0].shape[0]
+    n_expert = e_loc * ep
+    b, d = x.shape
+
+    # --- route (f32 softmax; tokens keep their activation dtype) ---
+    logits = (x.astype(jnp.float32) @
+              params["gate_w"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)            # [b, E]
+    gate = jnp.max(probs, axis=-1)                     # [b]
+    expert = jnp.argmax(probs, axis=-1)                # [b]
+    onehot = jax.nn.one_hot(expert, n_expert,
+                            dtype=jnp.float32)         # [b, E]
+
+    # --- pack into capacity slots (per source device, per expert) ---
+    capacity = max(1, int(capacity_factor * b / n_expert))
+    pos = jnp.cumsum(onehot, axis=0) - 1.0             # queue position
+    in_cap = (pos < capacity) * onehot                 # dropped past C
+    # dispatch is the single place capacity masking happens: one_hot of
+    # a dropped token's slot is zeroed here and nowhere else
+    dispatch = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32) * in_cap[..., None]
+    combine = dispatch * gate[:, None, None]
+
+    # --- dispatch: [b,d] -> [E, C, d] -> experts' owners over ICI ---
+    # split_axis == concat_axis keeps the exchange self-transposed, so
+    # jax.grad's transpose rule maps it onto the exact reverse exchange
+    xin = jnp.einsum("bd,bec->ecd", x.astype(jnp.float32), dispatch)
+    xin = xin.reshape(ep, e_loc, capacity, d)
+    xin = lax.all_to_all(xin, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                  # [ep_src, e_loc, C, d]
+    xin = jnp.transpose(xin, (1, 0, 2, 3)).reshape(e_loc,
+                                                   ep * capacity, d)
+
+    # --- expert FFN (vmapped over local experts; MXU batched) ---
+    if expert_fn is None:
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, params["w1"]) +
+                        params["b1"][:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + \
+            params["b2"][:, None, :]                   # [e_loc, ep*C, d]
+    else:
+        out = expert_fn(params["experts"], xin)        # [e_loc, ep*C, d]
+
+    # --- ship results back and combine ---
+    out = out.reshape(e_loc, ep, capacity, d)
+    out = jnp.transpose(out, (1, 0, 2, 3))             # [ep_src, e_loc, C, d]
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                  # [ep_owner, e_loc, C, d]
+    out = out.reshape(n_expert, capacity, d)
+    y = jnp.einsum("ecd,bec->bd", out, combine).astype(x.dtype)
+
+    # --- Switch aux loss: balance fraction-routed vs router mass ---
+    frac = jnp.mean(onehot, axis=0)                    # [E]
+    mass = jnp.mean(probs, axis=0)                     # [E]
+    # average over EVERY axis the tokens shard across (ep + dp), so the
+    # aux value is identical on all devices — out_specs declares it
+    # replicated and the router gradient must match the reported loss
+    stat_axes = (axis_name,) + tuple(batch_axes)
+    frac = lax.pmean(frac, stat_axes)
+    mass = lax.pmean(mass, stat_axes)
+    aux = n_expert * jnp.sum(frac * mass)
+    return y, aux
+
+
+def moe_shard_map(mesh, axis_name="ep", batch_axis="dp",
+                  capacity_factor=1.25, expert_fn=None,
+                  expert_param_template=None):
+    """Wrap switch_moe for `mesh`: tokens shard over (dp, ep) jointly,
+    expert weights shard over ep, the router replicates.
+
+    With `expert_fn`, params must be {"gate_w": ..., "experts": pytree}
+    where every experts leaf has a leading [E] axis (sharded over ep);
+    pass that pytree (or one with the same structure) as
+    expert_param_template so the shard_map specs can be derived.
+
+    Returns fn(params, x[B, d]) -> (y[B, d], aux)."""
+    axes = tuple(a for a in (batch_axis, axis_name) if a in mesh.shape)
+    x_spec = P(axes if len(axes) > 1 else (axes[0] if axes else None))
+    if expert_fn is None:
+        param_specs = {
+            "gate_w": P(), "w1": P(axis_name), "b1": P(axis_name),
+            "w2": P(axis_name), "b2": P(axis_name),
+        }
+    else:
+        if expert_param_template is None:
+            raise ValueError(
+                "expert_fn needs expert_param_template to derive specs")
+        param_specs = {
+            "gate_w": P(),
+            "experts": jax.tree_util.tree_map(
+                lambda _: P(axis_name), expert_param_template),
+        }
+    fn = functools.partial(
+        switch_moe, axis_name=axis_name, capacity_factor=capacity_factor,
+        batch_axes=tuple(a for a in axes if a != axis_name),
+        expert_fn=expert_fn)
+    return shard_map_norep(fn, mesh=mesh, in_specs=(param_specs, x_spec),
+                           out_specs=(x_spec, P()))

@@ -1,0 +1,121 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a "pp"
+mesh axis.
+
+The reference snapshot has no pipeline engine — its closest notion is
+per-layer device placement in ParallelNeuralNetwork
+(reference: paddle/gserver/gradientmachines/ParallelNeuralNetwork.h:25,
+which round-robins layers across GPUs and synchronizes on layer
+boundaries).  The TPU-first redesign is SPMD: every device runs the
+SAME program under shard_map; stage parameters are stacked on a leading
+axis sharded over "pp" (device i holds stage i), and microbatch
+activations hop stage-to-stage around the ICI ring with `lax.ppermute`.
+The whole schedule is a `lax.scan` over M + S - 1 ticks, so
+`jax.grad` differentiates straight through it — the transpose of
+ppermute is the reverse ring, which IS the backward pipeline; no
+hand-written 1F1B schedule needed.
+
+Composes with the other axes: batch ("dp") sharding applies to the
+microbatch dimension, tensor ("mp") sharding inside stage_fn, sequence
+("sp") via ring attention inside stage_fn.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .sharding import shard_map_norep
+
+__all__ = ["gpipe_spmd", "pipeline_apply", "split_microbatches",
+           "stack_stage_params"]
+
+
+def split_microbatches(x, n_microbatches):
+    """[B, ...] -> [M, B/M, ...] microbatch stream."""
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (b, n_microbatches))
+    return x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+
+
+def stack_stage_params(per_stage_params):
+    """List of S identical-pytree stage params -> one pytree whose
+    leaves have a leading stage axis [S, ...] (shard it over "pp")."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def gpipe_spmd(stage_fn, stacked_params, x_mb, axis_name="pp"):
+    """The per-device pipeline schedule; call inside shard_map.
+
+    stage_fn(params, x) -> y must preserve the activation shape
+    (classic stacked-stage pipelining, e.g. transformer blocks).
+
+    stacked_params: leaves [1, ...] locally (the "pp"-sharded stage
+    axis); x_mb: [M, mb, ...] microbatches (replicated across pp).
+    Returns [M, mb, ...] last-stage outputs, replicated across pp.
+    """
+    s = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    local = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+    m = x_mb.shape[0]
+    fwd = [(i, i + 1) for i in range(s - 1)]
+
+    def tick(carry, t):
+        state, outs = carry
+        # stage 0 ingests microbatch t (clamped; invalid ticks are
+        # masked out of `outs` below), later stages eat what the
+        # predecessor ppermuted in last tick
+        inj = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, m - 1), 0,
+                                       keepdims=False)
+        cur = jnp.where(idx == 0, inj, state)
+        y = stage_fn(local, cur)
+        # the last stage finishes microbatch t-(s-1) at tick t
+        o_idx = jnp.clip(t - (s - 1), 0, m - 1)
+        valid = jnp.logical_and(idx == s - 1, t >= s - 1)
+        outs = jnp.where(valid,
+                         lax.dynamic_update_index_in_dim(outs, y, o_idx, 0),
+                         outs)
+        state = lax.ppermute(y, axis_name, fwd)
+        return (state, outs), None
+
+    state0 = jnp.zeros_like(x_mb[0])
+    outs0 = jnp.zeros_like(x_mb)
+    (_, outs), _ = lax.scan(tick, (state0, outs0),
+                            jnp.arange(m + s - 1))
+    # only the last device holds real outputs; broadcast so the loss
+    # (and dp-sharded label math) runs replicated across pp
+    return lax.psum(jnp.where(idx == s - 1, outs, 0.0), axis_name)
+
+
+def pipeline_apply(mesh, stage_fn, stacked_params, x, n_microbatches,
+                   axis_name="pp", batch_axis="dp", remat=False):
+    """Run `x` through the pipelined stack of stages over `mesh`.
+
+    stacked_params: pytree with leading stage axis [S, ...]; S must
+    equal mesh.shape[axis_name].  x: [B, ...] global batch; with a
+    "dp" axis in the mesh the microbatch dimension is dp-sharded too.
+    Returns [B, ...] outputs of the final stage.
+    """
+    s = mesh.shape[axis_name]
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages != s:
+        raise ValueError("stacked_params has %d stages but mesh axis "
+                         "%r has size %d" % (n_stages, axis_name, s))
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    db = batch_axis if batch_axis in mesh.shape else None
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis_name), stacked_params)
+    x_spec = P(None, db)  # [M, mb, ...]: microbatch dim dp-sharded
+
+    mapped = shard_map_norep(
+        functools.partial(gpipe_spmd, fn, axis_name=axis_name),
+        mesh=mesh, in_specs=(param_specs, x_spec), out_specs=x_spec)
+
+    x_mb = split_microbatches(x, n_microbatches)
+    out_mb = mapped(stacked_params, x_mb)
+    return out_mb.reshape((-1,) + out_mb.shape[2:])

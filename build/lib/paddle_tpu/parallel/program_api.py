@@ -1,0 +1,176 @@
+"""Program-stack entries for pipeline and expert parallelism.
+
+`ParallelTrainer` already drives dp x mp from a built Program; these
+classes close the loop for the remaining axes: pipeline stages and MoE
+experts are *built with fluid layers as Programs*, lowered through
+FunctionalProgram (the same executor lowering every other program
+takes), and their parameters initialized by running the startup program
+— then the pp/ep schedules (pipeline.py / moe.py) stream them over the
+mesh.  The reference's closest notions are per-layer device placement
+(ParallelNeuralNetwork.h:25) and server-sharded parameters; here the
+framework surface is the Program and the distribution is GSPMD +
+shard_map underneath.
+"""
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .pipeline import pipeline_apply, stack_stage_params
+from .moe import moe_shard_map
+
+__all__ = ["lower_program_fn", "PipelineProgramTrainer",
+           "MoEProgramLayer"]
+
+
+@contextlib.contextmanager
+def _stable_names():
+    """Run a program build with a fresh unique_name counter so every
+    stage/expert Program gets IDENTICAL parameter names (fc_0.w_0 ...)
+    — names are per-program, so this collides with nothing — then
+    restore the caller's counters."""
+    from ..fluid import framework
+
+    saved = dict(framework._name_counters)
+    framework._name_counters.clear()
+    try:
+        yield
+    finally:
+        framework._name_counters.clear()
+        framework._name_counters.update(saved)
+
+
+def lower_program_fn(program, startup, feed_name, fetch_name, seed=None):
+    """Lower a single-input single-output Program to a pure
+    fn(params, x) -> y plus its startup-initialized parameters.
+
+    The Program must not mutate state (no optimizer ops): stages and
+    experts are pure transforms whose gradients flow through the
+    surrounding schedule.
+    """
+    from ..fluid.executor import Executor, CPUPlace
+    from ..core.scope import Scope
+    from ..jit import FunctionalProgram, state_from_scope
+
+    if seed is not None:
+        startup.random_seed = int(seed)
+    scope = Scope()
+    Executor(CPUPlace()).run(startup, scope=scope)
+    fp = FunctionalProgram(program, [feed_name], [fetch_name])
+    if fp.state_out_names:
+        raise ValueError(
+            "stage/expert programs must be pure (no optimizer or "
+            "state-mutating ops); %r writes %s"
+            % (program, sorted(fp.state_out_names)))
+    params = {n: np.asarray(v)
+              for n, v in state_from_scope(fp, scope).items()}
+
+    def fn(params, x):
+        (y,), _ = fp(params, {feed_name: x})
+        return y
+
+    return fn, params
+
+
+class PipelineProgramTrainer:
+    """GPipe over fluid-built stages.
+
+    build_stage(stage_idx) -> (program, startup, feed_name, fetch_name)
+    must append identical layer topology for every stage (stage weights
+    differ; names must match across stages so the per-stage states
+    stack into the [S, ...] pp-sharded pytree).
+
+    step(x, target) runs forward through the microbatch schedule,
+    backprops through it (the ppermute transpose IS the backward
+    pipeline), and applies SGD to the stacked stage weights.
+    """
+
+    def __init__(self, build_stage, mesh, n_microbatches, pp_axis="pp",
+                 lr=0.1):
+        self.mesh = mesh
+        self.n_microbatches = n_microbatches
+        self.pp_axis = pp_axis
+        self.lr = lr
+        n_stages = mesh.shape[pp_axis]
+        fns, states = [], []
+        for i in range(n_stages):
+            with _stable_names():
+                program, startup, feed, fetch = build_stage(i)
+            fn, params = lower_program_fn(program, startup, feed, fetch,
+                                          seed=i)
+            fns.append(fn)
+            states.append({n: jnp.asarray(v) for n, v in params.items()})
+        keys = sorted(states[0])
+        for s in states[1:]:
+            if sorted(s) != keys:
+                raise ValueError(
+                    "stage programs disagree on parameter names: "
+                    "%s vs %s" % (keys, sorted(s)))
+        self.stage_fn = fns[0]
+        self.stacked = stack_stage_params(states)
+        self._step = None
+
+    def _loss(self, stacked, x, tgt):
+        out = pipeline_apply(self.mesh, self.stage_fn, stacked, x,
+                             self.n_microbatches, axis_name=self.pp_axis)
+        return jnp.mean(jnp.square(out - tgt))
+
+    def step(self, x, tgt):
+        if self._step is None:
+            lr = self.lr
+
+            def _step(stacked, x, tgt):
+                loss, grads = jax.value_and_grad(self._loss)(stacked,
+                                                             x, tgt)
+                new = jax.tree_util.tree_map(
+                    lambda p, g: p - lr * g, stacked, grads)
+                return loss, new
+
+            self._step = jax.jit(_step)
+        loss, self.stacked = self._step(self.stacked, jnp.asarray(x),
+                                        jnp.asarray(tgt))
+        return float(loss)
+
+
+class MoEProgramLayer:
+    """Switch-MoE whose expert network is a fluid-built Program.
+
+    build_expert() -> (program, startup, feed_name, fetch_name): the
+    expert transform ([tokens, d] -> [tokens, d]).  One Program is
+    built per expert (startup seeded per expert for distinct inits);
+    their states stack into the [E, ...] ep-sharded pytree and apply
+    vmapped over the local expert axis inside the dispatch/combine
+    schedule.
+    """
+
+    def __init__(self, build_expert, n_experts, d_model, mesh,
+                 ep_axis="ep", batch_axis="dp", capacity_factor=1.25,
+                 seed=0):
+        expert_states, fns = [], []
+        for e in range(n_experts):
+            with _stable_names():
+                program, startup, feed, fetch = build_expert()
+            fn, params = lower_program_fn(program, startup, feed, fetch,
+                                          seed=seed + e)
+            fns.append(fn)
+            expert_states.append(
+                {n: jnp.asarray(v) for n, v in params.items()})
+        experts = stack_stage_params(expert_states)
+        rs = np.random.RandomState(seed)
+        self.params = {
+            "gate_w": jnp.asarray(
+                rs.randn(d_model, n_experts).astype(np.float32)
+                * (2.0 / d_model) ** 0.5),
+            "experts": experts,
+        }
+        expert_fn = jax.vmap(fns[0])   # over the local expert axis
+        self.fn = moe_shard_map(
+            mesh, axis_name=ep_axis, batch_axis=batch_axis,
+            capacity_factor=capacity_factor, expert_fn=expert_fn,
+            expert_param_template=experts)
+
+    def __call__(self, params, x):
+        return self.fn(params, x)

@@ -1,0 +1,143 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+No reference counterpart (the 2018 snapshot has no sequence
+parallelism — SURVEY §5 'long-context' gap); this is new TPU-first
+design: the sequence axis shards over a mesh axis ("sp"), K/V shards
+rotate around the ring with `lax.ppermute` (ICI neighbor exchange — the
+TPU analog of the reference's ring gather in
+MultiGradientMachine.h:61-76, but over sequence blocks instead of
+gradients), and each step folds into a running online-softmax
+accumulator so the full sequence never materializes on one chip.
+
+Ulysses-style all-to-all trades the sequence axis for the head axis
+instead: attention runs locally over full sequences for 1/sp of the
+heads (one all-to-all before, one after).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .sharding import shard_map_norep
+from ..kernels.flash_attention import flash_attention, NEG_INF
+
+__all__ = ["ring_attention", "ulysses_attention", "sp_shard_map"]
+
+
+def _block_attend(q, k, v, sm_scale, causal, q_start, k_start):
+    """Unnormalized blockwise attention: returns (acc, m, l) where
+    out = acc / l after all blocks merge.  q: [B,H,Tq,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        q_pos = q_start + jnp.arange(Tq)
+        k_pos = k_start + jnp.arange(Tk)
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                      # [B,H,Tq]
+    # fully-masked rows: exp(NEG_INF - NEG_INF)=1 would pollute l
+    p = jnp.where((s > NEG_INF / 2),
+                  jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    """Merge two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return (acc1 * a1[..., None] + acc2 * a2[..., None],
+            m, l1 * a1 + l2 * a2)
+
+
+def ring_attention(q, k, v, axis_name="sp", sm_scale=None, causal=False):
+    """Attention with q/k/v sharded [B,H,T/sp,D] along `axis_name`.
+
+    Call inside shard_map (or use sp_shard_map).  sp steps: local
+    q attends the rotating k/v shard; partials merge via online
+    softmax; k/v hop to the next neighbor with ppermute (ICI ring).
+    Differentiable (ppermute/scan transpose gives the reverse ring).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    sp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    q_start = my * t_local
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # step 0: attend the locally-held shard (no communication), then
+    # sp-1 hop+attend steps — sp-1 ppermutes total, none wasted
+    acc, m, l = _block_attend(q, k, v, sm_scale, causal, q_start,
+                              my * t_local)
+
+    def step(carry, i):
+        k_cur, v_cur, acc, m, l = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        # after i hops we hold shard (my - i) mod sp
+        src = (my - i) % sp
+        a, bm, bl = _block_attend(q, k_cur, v_cur, sm_scale, causal,
+                                  q_start, src * t_local)
+        acc, m, l = _merge(acc, m, l, a, bm, bl)
+        return (k_cur, v_cur, acc, m, l), None
+
+    (k, v, acc, m, l), _ = jax.lax.scan(
+        step, (k, v, acc, m, l), jnp.arange(1, sp))
+    safe_l = jnp.where(l > 0, l, 1.0)
+    return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name="sp", sm_scale=None,
+                      causal=False, use_flash=True):
+    """All-to-all sequence parallelism: swap the sharded axis from
+    sequence to heads, attend full sequences locally (flash kernel),
+    swap back.  q/k/v local: [B, H, T/sp, D]; H must divide by sp."""
+    sp = jax.lax.psum(1, axis_name)
+
+    def seq2head(x):
+        # [B, H, t, D] -> [B, H/sp, T, D]: head-group g ships to device
+        # g; each device gathers its head group's sequence shards
+        B, H, t, D = x.shape
+        x = x.reshape(B, sp, H // sp, t, D)
+        # split_axis=1 removed, gathered source axis inserted at 2:
+        # [B, H/sp, sp, t, D] with axis 2 enumerating sequence shards
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                               tiled=False)
+        return x.reshape(B, H // sp, sp * t, D)
+
+    def head2seq(x):
+        # [B, H/sp, T, D] -> [B, H, t, D] (inverse all-to-all)
+        B, Hs, T, D = x.shape
+        x = x.reshape(B, Hs, sp, T // sp, D)
+        # split_axis=2 removed, source axis (head groups) inserted at 1:
+        # [B, sp, H/sp, t, D]
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=False)
+        return x.reshape(B, Hs * sp, T // sp, D)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    if use_flash:
+        oh = flash_attention(qh, kh, vh, sm_scale, causal)
+    else:
+        from ..kernels.flash_attention import reference_attention
+
+        oh = reference_attention(qh, kh, vh, sm_scale, causal)
+    return head2seq(oh)
+
+
+def sp_shard_map(fn, mesh, axis_name="sp", dp_axis="dp", mp_axis="mp"):
+    """Wrap `fn(q,k,v,...)` in a shard_map over [B,H,T,D] tensors: T
+    shards along `axis_name`, and batch/heads stay sharded along
+    dp/mp when those axes exist — otherwise attention would all-gather
+    the full batch and all heads onto every device."""
+    batch = dp_axis if dp_axis in mesh.shape else None
+    heads = mp_axis if mp_axis in mesh.shape else None
+    spec = P(batch, heads, axis_name, None)
+    return shard_map_norep(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)

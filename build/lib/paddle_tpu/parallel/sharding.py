@@ -1,0 +1,142 @@
+"""Sharding specs for program state and feeds.
+
+The reference decides placement imperatively (scatter params to device
+threads, MultiGradientMachine.h:100-140; split LoDTensor across places,
+parallel_do_op.cc:37-47).  Here placement is declarative: every buffer
+gets a NamedSharding over the mesh and XLA GSPMD partitions the program.
+"""
+
+import re as _re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["param_spec", "batch_spec", "replicated", "shard_state",
+           "shard_feeds"]
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def param_spec(name, shape, mesh, mp_axis="mp", min_shard_dim=512):
+    """Default tensor-parallel layout for a parameter.
+
+    Large 2-D weights (fc/projection) shard their output dim over mp;
+    large embedding tables shard the vocab dim over mp (row-sharded like
+    the reference's blockwise pserver partitioning,
+    reference: pserver/ParameterServer2.h:73, distribute_transpiler.py:39);
+    everything else (conv filters, biases, BN stats) is replicated — conv
+    weights are small relative to activations, and replication keeps the
+    conv spatially partitionable by dp.
+    """
+    if mp_axis not in mesh.shape:
+        return P()
+    mp = mesh.shape[mp_axis]
+    if mp == 1:
+        return P()
+    if len(shape) == 2:
+        rows, cols = int(shape[0]), int(shape[1])
+        # embedding / big row-major tables: shard rows
+        if rows >= min_shard_dim * mp and rows % mp == 0 and rows >= cols:
+            return P(mp_axis, None)
+        if cols % mp == 0 and cols >= min_shard_dim:
+            return P(None, mp_axis)
+        if rows % mp == 0 and rows >= min_shard_dim:
+            return P(mp_axis, None)
+    return P()
+
+
+def batch_spec(shape, mesh, dp_axis="dp"):
+    """Feeds shard their leading (batch) dim over dp."""
+    if dp_axis not in mesh.shape or len(shape) == 0:
+        return P()
+    return P(dp_axis)
+
+
+def shard_state(state, mesh, var_shapes=None, mp_axis="mp"):
+    """Return {name: NamedSharding} for a state dict (arrays or abstract)."""
+    specs = {}
+    for name, v in state.items():
+        shape = v.shape if hasattr(v, "shape") else var_shapes[name]
+        specs[name] = NamedSharding(mesh, param_spec(name, shape, mesh,
+                                                     mp_axis=mp_axis))
+    return specs
+
+
+def shard_feeds(feeds, mesh, dp_axis="dp"):
+    specs = {}
+    for name, v in feeds.items():
+        specs[name] = NamedSharding(mesh, batch_spec(v.shape, mesh,
+                                                     dp_axis=dp_axis))
+    return specs
+
+
+# optimizer accumulator vars are named {param}_{acc}_{N} by
+# fluid/optimizer.py _add_accumulator; these are the acc strings of the
+# 11 optimizers
+_ACC_NAME = _re.compile(
+    r"_(velocity|moment[12]?|inf_norm|avg_squared_grad|"
+    r"avg_squared_update|mean_square|squared|linear)_\d+$")
+
+_OPTIMIZER_OPS = frozenset([
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad"])
+
+# optimizer-op input slots that are NOT accumulator state
+_NON_STATE_SLOTS = frozenset(["Param", "Grad", "LearningRate"])
+
+
+def optimizer_state_names(program):
+    """The exact accumulator var names of a built program: every input
+    to an optimizer op except Param/Grad/LearningRate.  Exact where the
+    name-suffix regex is a guess (a user var named '*_squared_3' would
+    fool the regex but can never appear in an optimizer slot)."""
+    names = set()
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type not in _OPTIMIZER_OPS:
+                continue
+            for slot, vars_ in op.desc.inputs.items():
+                if slot not in _NON_STATE_SLOTS:
+                    names.update(vars_)
+    return names
+
+
+def is_optimizer_state(name, known=None):
+    """`known` (from optimizer_state_names) is authoritative; the name
+    regex is the fallback for detached state dicts with no program."""
+    if known is not None:
+        return name in known
+    return bool(_ACC_NAME.search(name))
+
+
+def zero1_spec(base_spec, shape, mesh, dp_axis="dp"):
+    """ZeRO-1: shard an optimizer-state tensor over the dp axis on its
+    first free, divisible dim (on top of any mp sharding the matching
+    parameter has).  GSPMD then reduce-scatters the gradient into the
+    shard-wise accumulator update and all-gathers the updated params —
+    all-reduce bandwidth, 1/dp optimizer-state memory."""
+    if dp_axis not in mesh.shape or mesh.shape[dp_axis] == 1:
+        return base_spec
+    dp = mesh.shape[dp_axis]
+    dims = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    for i, (d, s) in enumerate(zip(dims, shape)):
+        if d is None and int(s) % dp == 0 and int(s) >= dp:
+            dims[i] = dp_axis
+            return P(*dims)
+    return base_spec
+
+
+def shard_map_norep(fn, **kwargs):
+    """shard_map with replication checking off, across jax versions
+    (`check_vma` replaced `check_rep`).  One shim shared by the ring /
+    pipeline / moe modules so the compat logic can't drift."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:
+        return shard_map(fn, check_rep=False, **kwargs)

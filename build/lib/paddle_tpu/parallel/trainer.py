@@ -1,0 +1,143 @@
+"""Mesh-parallel training driver.
+
+The reference's single-process multi-device trainer splits the batch,
+runs per-device threads, and ring-reduces gradients
+(reference: MultiGradientMachine.h:44-83, parallel_do_op.cc:112).  Here
+the whole train step (forward + backward + optimizer, one Program block)
+is ONE jitted function laid out over the mesh: batch sharded on dp,
+weights sharded on mp, gradients all-reduced by XLA over ICI.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..jit import FunctionalProgram, state_from_scope
+from .sharding import (param_spec, batch_spec, is_optimizer_state,
+                       optimizer_state_names, zero1_spec)
+
+__all__ = ["make_parallel_step", "ParallelTrainer"]
+
+
+def make_parallel_step(program, feed_names, fetch_names, mesh,
+                       state_template, dp_axis="dp", mp_axis="mp",
+                       donate_state=True, fp=None, zero_stage=0):
+    """Compile a Program block into a sharded step function.
+
+    Returns (step, state_shardings) where
+      step(state, feeds, rng) -> (fetches, new_state)
+    is jitted with: state sharded per param_spec, feeds sharded on dp,
+    fetches replicated (losses/metrics are scalars after mean).
+
+    zero_stage=1 additionally shards the optimizer accumulators
+    (velocity/moment/... vars) over dp — ZeRO-1: GSPMD turns the
+    gradient all-reduce into reduce-scatter + all-gather and each chip
+    keeps 1/dp of the optimizer state.
+    """
+    if fp is None:
+        fp = FunctionalProgram(program, feed_names, fetch_names)
+
+    # exact accumulator names from the program's optimizer ops (the
+    # name-suffix regex stays only for detached state dicts)
+    acc_names = optimizer_state_names(program) if program is not None \
+        else None
+
+    def spec_for(name, shape):
+        spec = param_spec(name, shape, mesh, mp_axis=mp_axis)
+        if zero_stage >= 1 and is_optimizer_state(name, known=acc_names):
+            spec = zero1_spec(spec, shape, mesh, dp_axis=dp_axis)
+        return spec
+
+    state_shardings = {
+        name: NamedSharding(mesh, spec_for(name, v.shape))
+        for name, v in state_template.items()
+    }
+
+    def step(state, feeds, rng):
+        feeds = {
+            n: jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, batch_spec(v.shape, mesh, dp_axis)))
+            if hasattr(v, "shape") else v
+            for n, v in feeds.items()
+        }
+        fetches, new_state = fp(state, feeds, rng)
+        return fetches, new_state
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(state_shardings, None, None),
+        out_shardings=(None, state_shardings),
+        donate_argnums=(0,) if donate_state else (),
+    )
+    return jitted, state_shardings
+
+
+class ParallelTrainer:
+    """End-to-end sharded trainer for a built Program.
+
+    Usage:
+        trainer = ParallelTrainer(main_prog, startup_prog,
+                                  feed_names=["image", "label"],
+                                  fetch_names=[loss.name], mesh=mesh)
+        trainer.init()                       # run startup, shard params
+        (loss,) = trainer.step({"image": x, "label": y})
+    """
+
+    def __init__(self, main_program, startup_program, feed_names,
+                 fetch_names, mesh, dp_axis="dp", mp_axis="mp", seed=0,
+                 zero_stage=0):
+        self.main_program = main_program
+        self.startup_program = startup_program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.mp_axis = mp_axis
+        self.zero_stage = zero_stage
+        self._base_rng = jax.random.PRNGKey(seed)
+        self._step_count = 0
+        self._step_fn = None
+        self.state = None
+
+    def init(self, scope=None, executor=None):
+        """Run the startup program (single device), then lay the state out
+        over the mesh per the sharding specs."""
+        from ..fluid.executor import Executor, CPUPlace
+        from ..core.scope import Scope
+
+        scope = scope or Scope()
+        exe = executor or Executor(CPUPlace())
+        exe.run(self.startup_program, scope=scope)
+
+        fp = FunctionalProgram(self.main_program, self.feed_names,
+                               self.fetch_names)
+        state = state_from_scope(fp, scope)
+        self._step_fn, self._shardings = make_parallel_step(
+            self.main_program, self.feed_names, self.fetch_names,
+            self.mesh, state, dp_axis=self.dp_axis, mp_axis=self.mp_axis,
+            fp=fp, zero_stage=self.zero_stage)
+        # place state on the mesh
+        self.state = {
+            n: jax.device_put(np.asarray(v), self._shardings[n])
+            for n, v in state.items()
+        }
+        return self
+
+    def step(self, feeds):
+        rng = jax.random.fold_in(self._base_rng, self._step_count)
+        self._step_count += 1
+        feeds = {n: jnp_asarray(v) for n, v in feeds.items()}
+        fetches, self.state = self._step_fn(self.state, feeds, rng)
+        return fetches
+
+    def fetch_state(self, name):
+        return np.asarray(self.state[name])
+
+
+def jnp_asarray(v):
+    import jax.numpy as jnp
+
+    if isinstance(v, jax.Array):
+        return v
+    return jnp.asarray(np.asarray(v))

@@ -1,0 +1,98 @@
+"""Reader creators (reference: python/paddle/v2/reader/creator.py —
+np_array, text_file, recordio; the recordio path feeds from the native
+chunk files that the master leases out)."""
+
+import pickle
+
+__all__ = ["np_array", "text_file", "recordio", "cloud_reader"]
+
+
+def np_array(x):
+    """reference: creator.py np_array — yield rows of an ndarray."""
+
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """reference: creator.py text_file — yield lines without newline."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=None):
+    """Read pickled samples from native RecordIO chunk files
+    (reference: creator.py recordio over recordio.reader; here the
+    container is native/recordio.cc with per-record CRC)."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        from ..native import RecordIOReader
+
+        for path in paths:
+            rd = RecordIOReader(path)
+            try:
+                for rec in rd:
+                    yield pickle.loads(rec)
+            finally:
+                rd.close()
+
+    return reader
+
+
+def recordio_writer(path, samples):
+    """Write an iterable of picklable samples as one chunk file."""
+    from ..native import RecordIOWriter
+
+    w = RecordIOWriter(path)
+    try:
+        for s in samples:
+            w.write(pickle.dumps(s))
+    finally:
+        w.close()
+
+
+def cloud_reader(master_endpoint, pass_num=1):
+    """Fault-tolerant distributed reader: lease chunk tasks from the
+    master, read their records, report finish/failure (reference:
+    python/paddle/v2/master/client.py next_record + reader integration;
+    task lease/timeout semantics of go/master/service.go).
+    """
+    host, port = master_endpoint.rsplit(":", 1)
+
+    def reader():
+        from ..native import MasterClient
+
+        c = MasterClient(host, int(port))
+        try:
+            passes = 0
+            while passes < pass_num:
+                tid, chunks = c.get_task()
+                if tid == MasterClient.PASS_FINISHED:
+                    passes += 1
+                    continue
+                if tid == MasterClient.NO_TASK:
+                    import time
+
+                    time.sleep(0.05)
+                    continue
+                try:
+                    for sample in recordio(chunks)():
+                        yield sample
+                except Exception:
+                    c.task_failed(tid)
+                    raise
+                c.task_finished(tid)
+        finally:
+            c.close()
+
+    return reader

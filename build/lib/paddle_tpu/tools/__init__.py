@@ -1,0 +1,1 @@
+"""Operational tools (cluster launch, model conversion)."""

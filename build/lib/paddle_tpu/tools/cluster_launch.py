@@ -1,0 +1,94 @@
+"""Cluster job launcher: spawn pservers + trainers for one training job.
+
+reference: paddle/scripts/cluster_train/paddle.py (fabric/ssh job
+spawner setting PADDLE_* env per process) and the env-var role protocol
+of tests/book_distribute/notest_dist_fit_a_line.py:45-53
+(TRAINING_ROLE / PSERVERS / TRAINER_ID).  Local mode runs everything on
+this host; remote mode emits the per-host commands (ssh execution is
+site-specific by design).
+
+Usage:
+    python -m paddle_tpu.tools.cluster_launch \
+        --pservers=127.0.0.1:7164,127.0.0.1:7165 --trainers=2 \
+        [--async] train.py [script args...]
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+__all__ = ["launch", "main"]
+
+
+def launch(script_argv, pservers, trainers, sync=True, env=None,
+           python=sys.executable):
+    """Spawn len(pservers) pserver processes + `trainers` trainer
+    processes; returns (pserver_procs, trainer_procs)."""
+    base_env = dict(os.environ)
+    base_env.update(env or {})
+    base_env["PSERVERS"] = ",".join(pservers)
+    base_env["TRAINERS"] = str(trainers)
+    base_env["PADDLE_SYNC"] = "1" if sync else "0"
+
+    ps_procs = []
+    for ep in pservers:
+        code = ("import os,sys,signal;"
+                "from paddle_tpu.distributed import run_pserver;"
+                "s=run_pserver(os.environ['PSERVER_ENDPOINT'],"
+                "trainers=int(os.environ['TRAINERS']),"
+                "sync=os.environ['PADDLE_SYNC']=='1');"
+                "print('pserver ready', flush=True);"
+                "signal.pause()")
+        ps_procs.append(subprocess.Popen(
+            [python, "-c", code],
+            env={**base_env, "TRAINING_ROLE": "PSERVER",
+                 "PSERVER_ENDPOINT": ep},
+            stdout=subprocess.PIPE, text=True))
+    # trainers have no connect retry: wait until every pserver has
+    # bound its port before spawning them
+    for p in ps_procs:
+        line = p.stdout.readline()
+        if "ready" not in line:
+            raise RuntimeError("pserver failed to start: %r" % line)
+
+    tr_procs = []
+    for tid in range(trainers):
+        tr_procs.append(subprocess.Popen(
+            [python] + list(script_argv),
+            env={**base_env, "TRAINING_ROLE": "TRAINER",
+                 "TRAINER_ID": str(tid)}))
+    return ps_procs, tr_procs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pservers", required=True,
+                    help="comma-separated host:port endpoints")
+    ap.add_argument("--trainers", type=int, default=1)
+    ap.add_argument("--async", dest="sync", action="store_false",
+                    help="async SGD (reference: asyncSGD)")
+    ap.add_argument("script", nargs=argparse.REMAINDER,
+                    help="trainer script + args")
+    args = ap.parse_args(argv)
+    if not args.script:
+        ap.error("missing trainer script")
+
+    pservers = args.pservers.split(",")
+    ps_procs, tr_procs = launch(args.script, pservers, args.trainers,
+                                sync=args.sync)
+    rc = 0
+    try:
+        for p in tr_procs:
+            rc |= p.wait()
+    finally:
+        for p in ps_procs:
+            p.send_signal(signal.SIGTERM)
+        for p in ps_procs:
+            p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Process-level utilities: flags, logging helpers.
+
+Maps the reference's paddle/utils (gflags registry Flags.cpp:18-100,
+Stat timers — timers live in fluid.profiler here).
+"""
+
+from . import flags
+from .flags import DEFINE_flag, get_flag, set_flag, parse_flags_from_env
+
+__all__ = ["flags", "DEFINE_flag", "get_flag", "set_flag",
+           "parse_flags_from_env"]

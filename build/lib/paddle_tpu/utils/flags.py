@@ -1,0 +1,75 @@
+"""Process flags with env bootstrap.
+
+TPU-native equivalent of the reference's gflags tiers (reference:
+paddle/utils/Flags.cpp:18-100 flag registry; python/paddle/v2/fluid/
+__init__.py:89-96 `init_gflags(--tryfromenv=...)` pulling FLAGS_* from
+the environment).  Flags registered here are read at runtime by the
+executor (check_nan_inf, memory benchmarking) and trainers.
+"""
+
+import os
+
+__all__ = ["DEFINE_flag", "get_flag", "set_flag", "parse_flags_from_env",
+           "all_flags"]
+
+_FLAGS = {}
+
+
+def _coerce(value, default):
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def DEFINE_flag(name, default, help_str=""):
+    _FLAGS[name] = {"value": default, "default": default,
+                    "help": help_str}
+    return default
+
+
+def get_flag(name):
+    return _FLAGS[name]["value"]
+
+
+def set_flag(name, value):
+    f = _FLAGS[name]
+    f["value"] = _coerce(value, f["default"])
+
+
+def all_flags():
+    return {k: v["value"] for k, v in _FLAGS.items()}
+
+
+def parse_flags_from_env(names=None):
+    """Read FLAGS_<name> env vars (reference: the __init__.py:89-96
+    `tryfromenv` bootstrap)."""
+    for name in (names or list(_FLAGS)):
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            set_flag(name, env)
+
+
+# core flags (reference: executor.cc:28-31, Flags.cpp)
+DEFINE_flag("check_nan_inf", False,
+            "scan every op output for NaN/Inf in eager mode "
+            "(reference: executor.cc:29)")
+DEFINE_flag("do_memory_benchmark", False,
+            "log per-segment buffer sizes (reference: executor.cc:130)")
+DEFINE_flag("use_debug_nans", False,
+            "enable jax debug_nans for compiled segments")
+DEFINE_flag("amp_bf16", False,
+            "cast MXU op operands (mul/matmul/conv) to bfloat16 with "
+            "f32 accumulation (see fluid.amp)")
+DEFINE_flag("amp_bf16_act", True,
+            "when amp_bf16 is on, keep activations bfloat16 between ops "
+            "instead of casting every MXU output back to f32 — halves "
+            "HBM traffic on the elementwise/norm chains; statistics, "
+            "losses, and master weights stay f32")
+
+parse_flags_from_env()

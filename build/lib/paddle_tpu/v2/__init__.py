@@ -1,0 +1,39 @@
+"""paddle_tpu.v2 — the legacy "v2" user API, as a facade over the fluid
+stack (reference: python/paddle/v2/__init__.py).
+
+The reference's v2 stack is a separate config-driven trainer
+(ModelConfig proto -> C++ GradientMachine).  Here the same user-facing
+API — ``paddle.layer.*`` builders, ``paddle.trainer.SGD`` event loop,
+``paddle.parameters.Parameters``, ``paddle.infer`` — builds a fluid
+Program underneath, so one TPU-native stack serves both APIs.
+"""
+
+from .config import init, _place
+
+from . import activation
+from . import attr
+from . import data_type
+from . import evaluator
+from . import event
+from . import inference
+from . import layer
+from . import networks
+from . import optimizer
+from . import parameters
+from . import plot
+from . import pooling
+from . import trainer
+
+from .. import dataset
+from .. import reader
+from ..reader.decorator import batch as minibatch
+
+batch = minibatch
+infer = inference.infer
+
+__all__ = [
+    "init", "activation", "attr", "data_type", "event", "inference",
+    "layer", "networks", "optimizer", "parameters", "pooling", "trainer",
+    "dataset", "reader", "batch", "minibatch", "infer",
+]
+

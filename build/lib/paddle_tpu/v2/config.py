@@ -1,0 +1,19 @@
+"""v2 process-level config state (reference: the gflags handled by
+python/paddle/v2/__init__.py init)."""
+
+_state = {"initialized": False, "use_tpu": False, "trainer_count": 1}
+
+
+def init(use_gpu=False, use_tpu=None, trainer_count=1, **kwargs):
+    _state["initialized"] = True
+    _state["use_tpu"] = (bool(use_tpu) if use_tpu is not None
+                         else bool(use_gpu))
+    _state["trainer_count"] = trainer_count
+
+
+def _place():
+    from .. import fluid
+
+    if _state["use_tpu"]:
+        return fluid.TPUPlace(0)
+    return fluid.CPUPlace()

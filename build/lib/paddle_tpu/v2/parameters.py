@@ -1,0 +1,125 @@
+"""v2 Parameters: numpy views over the trained state + tar serialization
+(reference: python/paddle/v2/parameters.py — Parameters, to_tar:300s,
+from_tar; tar holds one raw file per parameter)."""
+
+import io
+import json
+import tarfile
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import framework
+from ..core import scope as scope_mod
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    """Name -> numpy parameter view bound to a scope."""
+
+    def __init__(self, program=None, scope=None):
+        self._program = program or framework.default_main_program()
+        self._scope = scope or scope_mod.global_scope()
+
+    def _param_vars(self):
+        out = {}
+        for block in self._program.blocks:
+            for var in block.vars.values():
+                if isinstance(var, framework.Parameter):
+                    out[var.name] = var
+        return out
+
+    def keys(self):
+        return sorted(self._param_vars())
+
+    def names(self):
+        return self.keys()
+
+    def has_key(self, key):
+        return key in self._param_vars()
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self):
+        return len(self._param_vars())
+
+    def get(self, name):
+        val = self._scope.get(name)
+        if val is None:
+            raise ValueError("parameter %r has no value yet" % name)
+        return np.asarray(val)
+
+    __getitem__ = get
+
+    def get_shape(self, name):
+        return tuple(self._param_vars()[name].shape)
+
+    def set(self, name, value):
+        old = self._scope.get(name)
+        value = np.asarray(value)
+        if old is not None:
+            old = np.asarray(old)
+            value = value.reshape(old.shape).astype(old.dtype)
+        self._scope.set(name, value)
+
+    __setitem__ = set
+
+    def to_tar(self, f):
+        """One .npy member per parameter + a manifest (reference format
+        is one raw buffer per param + proto config; .npy keeps dtype and
+        shape self-describing)."""
+        tar = tarfile.open(fileobj=f, mode="w")
+        names = self.keys()
+        manifest = json.dumps({"parameters": names}).encode()
+        info = tarfile.TarInfo("manifest.json")
+        info.size = len(manifest)
+        tar.addfile(info, io.BytesIO(manifest))
+        for name in names:
+            buf = io.BytesIO()
+            np.save(buf, self.get(name))
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name + ".npy")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        tar.close()
+
+    @classmethod
+    def from_tar(cls, f, program=None, scope=None):
+        params = cls(program=program, scope=scope)
+        tar = tarfile.open(fileobj=f, mode="r")
+        for member in tar.getmembers():
+            if not member.name.endswith(".npy"):
+                continue
+            data = tar.extractfile(member).read()
+            arr = np.load(io.BytesIO(data))
+            params.set(member.name[:-4], arr)
+        tar.close()
+        return params
+
+    def init_from_tar(self, f):
+        tar = tarfile.open(fileobj=f, mode="r")
+        for member in tar.getmembers():
+            if not member.name.endswith(".npy"):
+                continue
+            name = member.name[:-4]
+            if not self.has_key(name):
+                continue
+            arr = np.load(io.BytesIO(tar.extractfile(member).read()))
+            self.set(name, arr)
+        tar.close()
+
+
+def create(cost_or_program=None):
+    """Run the startup program and return a Parameters view (reference:
+    parameters.create(topology) — topology here is the default
+    program)."""
+    from .config import _place
+
+    program = None
+    if cost_or_program is not None and hasattr(cost_or_program, "blocks"):
+        program = cost_or_program
+    exe = fluid.Executor(_place())
+    exe.run(framework.default_startup_program())
+    return Parameters(program=program)

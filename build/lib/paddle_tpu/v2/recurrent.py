@@ -1,0 +1,634 @@
+"""v2 recurrent layer groups + beam-search sequence generation.
+
+reference surface: trainer_config_helpers/layers.py recurrent_group:4082,
+memory:3590, beam_search:4406, StaticInput:4051, GeneratedInput:4215,
+get_output_layer, maxid_layer, eos_layer; the runtime they configure is
+RecurrentGradientMachine (paddle/gserver/gradientmachines/
+RecurrentGradientMachine.h:32,307-309 — per-timestep dynamic expansion
+and generateSequence/beamSearch).
+
+TPU-first redesign:
+  * recurrent_group traces the user's step function once into a
+    DynamicRNN sub-block which lowers to ONE lax.scan (compiled, masked
+    memory carries) — not per-timestep graph expansion.
+  * beam_search traces the same step into a generation sub-block; at
+    inference the decode loop runs the compiled step over a dense
+    [batch*beam] state with host top-k bookkeeping, the same loop
+    structure as RecurrentGradientMachine::beamSearch but with each
+    step XLA-jitted.  (The fully-jitted dense decoder lives in
+    models/decode.py; this path keeps full LoD/attention generality.)
+"""
+
+import contextlib
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import framework
+from ..fluid import layers as fl
+from ..fluid.param_attr import ParamAttr
+from ..core.ragged import RaggedTensor
+
+__all__ = [
+    "StaticInput", "SubsequenceInput", "GeneratedInput", "memory",
+    "recurrent_group", "beam_search", "get_output_layer", "eos_layer",
+    "maxid_layer", "register_layer_output",
+]
+
+
+# ---------------------------------------------------------------------------
+# named layer outputs (v2 layers link memories by layer NAME)
+# ---------------------------------------------------------------------------
+
+def _named_layers(program=None):
+    if program is None:
+        program = framework.default_main_program()
+    if not hasattr(program, "_v2_named_layers"):
+        program._v2_named_layers = {}
+    return program._v2_named_layers
+
+
+def register_layer_output(name, var):
+    """Record `var` as the output of the v2 layer called `name` (the
+    reference links memory() to layers through these names)."""
+    if name:
+        _named_layers()[name] = var
+    return var
+
+
+def get_layer(name):
+    return _named_layers().get(name)
+
+
+# ---------------------------------------------------------------------------
+# input markers
+# ---------------------------------------------------------------------------
+
+class StaticInput:
+    """Imported unchanged into every time step (reference: layers.py
+    StaticInput:4051)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq or getattr(input, "lod_level", 0) > 0
+        self.size = size
+
+
+class _SubseqInput:
+    def __init__(self, input):
+        self.input = input
+
+
+def SubsequenceInput(input):
+    """Scatter a nested (lod_level 2) sequence by outer sequence
+    (reference: layers.py SubsequenceInput:4067)."""
+    return _SubseqInput(input)
+
+
+class BaseGeneratedInput:
+    def __init__(self):
+        self.bos_id = None
+        self.eos_id = None
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """The previously generated word fed back through an embedding
+    (reference: layers.py GeneratedInput:4215)."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        BaseGeneratedInput.__init__(self)
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+# ---------------------------------------------------------------------------
+# memory()
+# ---------------------------------------------------------------------------
+
+_cur_group = None
+
+
+@contextlib.contextmanager
+def _activate(group):
+    global _cur_group
+    prev = _cur_group
+    _cur_group = group
+    try:
+        yield
+    finally:
+        _cur_group = prev
+
+
+def memory(name, size, memory_name=None, is_seq=False, boot_layer=None,
+           boot_bias=None, boot_bias_active_type=None,
+           boot_with_const_id=None):
+    """The named layer's output at the previous time step (reference:
+    layers.py memory:3590).  Must be called inside a recurrent_group /
+    beam_search step function."""
+    if _cur_group is None:
+        raise RuntimeError(
+            "memory() must be called inside a recurrent_group or "
+            "beam_search step function")
+    return _cur_group.add_memory(
+        name or memory_name, size, boot_layer=boot_layer,
+        boot_with_const_id=boot_with_const_id)
+
+
+class _RecurrentGroup:
+    """Training-path group: memories become DynamicRNN loop carries."""
+
+    def __init__(self, drnn):
+        self.drnn = drnn
+        self._links = []       # (mem_var, layer_name)
+
+    def add_memory(self, name, size, boot_layer=None,
+                   boot_with_const_id=None):
+        if boot_with_const_id is not None:
+            raise NotImplementedError(
+                "boot_with_const_id only applies to generation "
+                "(beam_search)")
+        if boot_layer is not None:
+            mem = self.drnn.memory(init=boot_layer)
+        else:
+            if not self.drnn.seq_inputs:
+                raise ValueError(
+                    "memory(size=...) without boot_layer needs at least "
+                    "one sequence input declared before it")
+            batch_ref = self.drnn.seq_inputs[0][1]
+            mem = self.drnn.memory(shape=[size], batch_ref=batch_ref,
+                                   value=0.0)
+        if name:
+            self._links.append((mem, name))
+        mem.set_input = lambda layer, _m=mem: self.link(_m, layer)
+        mem._v2_memory_name = name
+        return mem
+
+    def link(self, mem, layer):
+        self._links = [(m, n) for m, n in self._links if m is not mem]
+        self.drnn.update_memory(mem, layer)
+
+    def finalize(self):
+        for mem, name in self._links:
+            target = get_layer(name)
+            if target is None:
+                raise ValueError(
+                    "memory(name=%r) was never linked: no layer output "
+                    "registered under that name inside the step "
+                    "(pass name=%r to the producing layer)" % (name, name))
+            self.drnn.update_memory(mem, target)
+
+
+class _NestedGroup:
+    """Group for the flattened nested-sequence path: every inner
+    sequence runs as an independent batch element, so there is no
+    cross-subsequence recurrence to carry."""
+
+    def add_memory(self, name, size, boot_layer=None,
+                   boot_with_const_id=None):
+        raise NotImplementedError(
+            "memory() across subsequences is not supported by the "
+            "flattened SubsequenceInput lowering; encode each "
+            "subsequence here, then run an ordinary recurrent_group "
+            "over the returned sentence-level sequence for the outer "
+            "recurrence")
+
+    def finalize(self):
+        pass
+
+
+def _nested_recurrent_group(step, inputs, name):
+    """SubsequenceInput lowering (reference nested-sequence mode:
+    RecurrentGradientMachine.h:32): unnest lod-2 inputs into a lod-1
+    batch of inner sequences, trace `step` ONCE over that batch (inner
+    recurrent_groups ride the normal lod-1 scan), and reattach the
+    outer row_splits to every output — dense per-subsequence rows
+    become a sentence-level sequence, sequence outputs become nested
+    again."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper(name or "nested_recurrent_group")
+    inners, outer_ref = {}, None
+    for idx, i in enumerate(inputs):
+        if not isinstance(i, _SubseqInput):
+            continue
+        x = i.input
+        if getattr(x, "lod_level", 0) < 2:
+            raise ValueError(
+                "SubsequenceInput needs a nested (lod_level 2) "
+                "sequence; got lod_level %d" % getattr(x, "lod_level", 0))
+        inner = helper.create_tmp_variable(x.dtype, lod_level=1)
+        oref = helper.create_tmp_variable("float32", lod_level=1)
+        helper.append_op(type="seq_unnest", inputs={"X": [x]},
+                         outputs={"Inner": [inner], "OuterRef": [oref]})
+        inners[idx] = inner
+        if outer_ref is None:
+            outer_ref = oref
+
+    args = []
+    for idx, i in enumerate(inputs):
+        if isinstance(i, _SubseqInput):
+            args.append(inners[idx])
+        elif isinstance(i, StaticInput):
+            if i.is_seq:
+                raise NotImplementedError(
+                    "StaticInput(is_seq=True) inside a nested group")
+            exp = helper.create_tmp_variable(i.input.dtype)
+            helper.append_op(type="seq_outer_expand",
+                             inputs={"X": [i.input],
+                                     "OuterRef": [outer_ref]},
+                             outputs={"Out": [exp]})
+            args.append(exp)
+        else:
+            raise ValueError(
+                "nested recurrent_group inputs must be SubsequenceInput "
+                "or StaticInput (got %r)" % (i,))
+
+    with _activate(_NestedGroup()):
+        outs = step(*args)
+    outs_list = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    results = []
+    for o in outs_list:
+        lod = 2 if getattr(o, "lod_level", 0) else 1
+        out = helper.create_tmp_variable(o.dtype, lod_level=lod)
+        helper.append_op(type="seq_renest",
+                         inputs={"X": [o], "OuterRef": [outer_ref]},
+                         outputs={"Out": [out]})
+        results.append(out)
+    return results[0] if len(results) == 1 else results
+
+
+def recurrent_group(step, input, reverse=False, name=None,
+                    targetInlink=None):
+    """Iterate `step` over the time steps of the sequence inputs
+    (reference: layers.py recurrent_group:4082 over
+    RecurrentGradientMachine).  Lowered to one masked lax.scan via
+    DynamicRNN; StaticInput vars enter the scan closure unchanged.
+    With SubsequenceInput (nested lod-2) inputs the group flattens the
+    outer level into the batch instead (see _nested_recurrent_group);
+    `reverse` is identity there since the flattened form has no
+    cross-subsequence order dependence."""
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    if any(isinstance(i, _SubseqInput) for i in inputs):
+        return _nested_recurrent_group(step, inputs, name)
+
+    # reverse inlinks before the scan; outputs un-reversed after
+    prepared = []
+    for i in inputs:
+        if isinstance(i, StaticInput):
+            prepared.append(i)
+        elif isinstance(i, framework.Variable):
+            prepared.append(fl.sequence_reverse(i) if reverse else i)
+        else:
+            raise ValueError("recurrent_group inputs must be sequence "
+                             "Variables or StaticInput (got %r)" % (i,))
+
+    drnn = fl.DynamicRNN(name=name)
+    group = _RecurrentGroup(drnn)
+    with drnn.block():
+        args = []
+        for i in prepared:
+            if isinstance(i, StaticInput):
+                args.append(i.input)
+            else:
+                args.append(drnn.step_input(i))
+        with _activate(group):
+            outs = step(*args)
+        outs_list = list(outs) if isinstance(outs, (list, tuple)) \
+            else [outs]
+        group.finalize()
+        drnn.output(*outs_list)
+    result = drnn()
+    result = result if isinstance(result, list) else [result]
+    if reverse:
+        result = [fl.sequence_reverse(r) for r in result]
+    return result[0] if len(result) == 1 else result
+
+
+# ---------------------------------------------------------------------------
+# misc layers of the recurrent surface
+# ---------------------------------------------------------------------------
+
+def get_output_layer(input, arg_name, name=None, **kw):
+    """Extract a non-default output of a layer, e.g. the lstm step's
+    cell state (reference: layers.py get_output_layer)."""
+    extra = getattr(input, "_v2_extra_outputs", None)
+    if not extra or arg_name not in extra:
+        raise ValueError("layer has no extra output %r" % arg_name)
+    return register_layer_output(name, extra[arg_name])
+
+
+def maxid_layer(input, name=None, **kw):
+    _, idx = fl.topk(input=input, k=1)
+    return register_layer_output(name, idx)
+
+
+def eos_layer(input, eos_id, name=None, **kw):
+    """1.0 where the id equals eos_id (reference: layers.py
+    eos_layer:4366)."""
+    eos = fl.fill_constant(shape=[1], dtype=input.dtype,
+                           value=float(eos_id))
+    return register_layer_output(name, fl.equal(x=input, y=eos))
+
+
+# ---------------------------------------------------------------------------
+# beam_search generation
+# ---------------------------------------------------------------------------
+
+class _GenGroup:
+    """Generation-path group: memories become decode-loop state fed into
+    the traced step block each iteration."""
+
+    def __init__(self, block):
+        self.block = block
+        self.mems = []         # dicts: var, name, size, boot (outer var
+        #                        name or None), const_id, new (var name)
+        self._links = []
+
+    def add_memory(self, name, size, boot_layer=None,
+                   boot_with_const_id=None):
+        dtype = "int64" if boot_with_const_id is not None else "float32"
+        var = self.block.create_var(
+            name=framework.unique_name("@".join(["gen_mem", name or "m"])),
+            dtype=dtype,
+            shape=(-1, 1) if boot_with_const_id is not None
+            else (-1, size))
+        rec = {"var": var, "name": name, "size": size,
+               "boot": boot_layer.name if boot_layer is not None else None,
+               "const_id": boot_with_const_id, "new": None}
+        self.mems.append(rec)
+        if name:
+            self._links.append((rec, name))
+        var.set_input = lambda layer, _r=rec: _r.update(
+            {"new": layer.name})
+        return var
+
+    def finalize(self):
+        for rec, name in self._links:
+            if rec["new"] is not None:
+                continue
+            target = get_layer(name)
+            if target is not None:
+                rec["new"] = target.name
+        for rec in self.mems:
+            if rec["const_id"] is None and rec["new"] is None:
+                raise ValueError(
+                    "generation memory %r never updated: register a "
+                    "layer output under its name or call set_input()"
+                    % (rec["name"] or rec["var"].name))
+
+
+class _BeamGenSpec:
+    def __init__(self, program, block_idx, prev_ids_name, probs_name,
+                 mems, statics, bos_id, eos_id, beam_size, max_length,
+                 num_results_per_sample):
+        self.program = program
+        self.block_idx = block_idx
+        self.prev_ids_name = prev_ids_name
+        self.probs_name = probs_name
+        self.mems = mems             # list of dicts (see _GenGroup)
+        self.statics = statics       # [(sub var name == outer name?, ...)]
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.beam_size = beam_size
+        self.max_length = max_length
+        self.num_results_per_sample = num_results_per_sample
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
+                name=None, num_results_per_sample=None):
+    """Configure beam-search generation over `step` (reference: layers.py
+    beam_search:4406 over RecurrentGradientMachine::beamSearch).
+
+    Returns a handle Variable; run it with paddle.infer(
+    output_layer=handle, field=['prob', 'id']) — 'prob' is a
+    [batch, num_results] score array, 'id' the flat id stream with each
+    result as bos ... eos -1 (the reference's output format)."""
+    if num_results_per_sample is None:
+        num_results_per_sample = beam_size
+    num_results_per_sample = min(num_results_per_sample, beam_size)
+
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    gen = None
+    for i in inputs:
+        if isinstance(i, BaseGeneratedInput):
+            if gen is not None:
+                raise ValueError("beam_search accepts exactly one "
+                                 "GeneratedInput")
+            gen = i
+    if gen is None:
+        raise ValueError("beam_search needs a GeneratedInput")
+    gen.bos_id, gen.eos_id = bos_id, eos_id
+
+    prog = framework.default_main_program()
+    parent = prog.current_block()
+    sub = prog.create_block()
+    try:
+        group = _GenGroup(sub)
+        prev_ids = group.add_memory("__beam_search_predict__", gen.size,
+                                    boot_with_const_id=bos_id)
+
+        statics = []
+        args = []
+        for i in inputs:
+            if isinstance(i, BaseGeneratedInput):
+                emb = fl.embedding(
+                    input=prev_ids,
+                    size=[gen.size, gen.embedding_size],
+                    param_attr=ParamAttr(name=gen.embedding_name))
+                args.append(emb)
+            elif isinstance(i, StaticInput):
+                statics.append(i.input.name)
+                args.append(i.input)
+            else:
+                raise ValueError(
+                    "beam_search inputs must be StaticInput or "
+                    "GeneratedInput (got %r)" % (i,))
+
+        with _activate(group):
+            outs = step(*args)
+        outs_list = list(outs) if isinstance(outs, (list, tuple)) \
+            else [outs]
+        group.finalize()
+        probs = outs_list[0]
+    finally:
+        prog.rollback()
+
+    handle = parent.create_var(
+        name=framework.unique_name("beam_gen"), dtype="int64")
+    handle._v2_beam_spec = _BeamGenSpec(
+        prog, sub.idx, prev_ids.name, probs.name,
+        [m for m in group.mems if m["const_id"] is None],
+        statics, bos_id, eos_id, beam_size, max_length,
+        num_results_per_sample)
+    return handle
+
+
+# ---------------------------------------------------------------------------
+# the generation loop (RecurrentGradientMachine::beamSearch analog)
+# ---------------------------------------------------------------------------
+
+def _ragged_repeat(rt, k):
+    """Repeat each sequence k times consecutively (beam expansion of a
+    ragged static input)."""
+    vals = np.asarray(rt.values)
+    splits = np.asarray(rt.last_splits())
+    n = len(splits) - 1
+    segs, new_splits = [], [0]
+    for i in range(n):
+        seg = vals[splits[i]:splits[i + 1]]
+        for _ in range(k):
+            segs.append(seg)
+            new_splits.append(new_splits[-1] + len(seg))
+    out_vals = np.concatenate(segs, 0) if segs else vals[:0]
+    return RaggedTensor(out_vals, [np.asarray(new_splits, np.int32)])
+
+
+def _is_persistable(program, block_idx, name):
+    bd = program.desc.block(block_idx)
+    while True:
+        if name in bd.vars:
+            return bool(bd.vars[name].persistable)
+        if bd.parent_idx < 0:
+            return False
+        bd = program.desc.block(bd.parent_idx)
+
+
+def run_beam_search(spec, boot_values, static_values, batch_size,
+                    scope=None, rng_seed=0):
+    """Run the decode loop.  boot_values: {mem name: [B, size] np},
+    static_values: {outer var name: value}.  Returns
+    (scores [B, num_results], id stream list with -1 separators)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.scope import global_scope
+    from ..fluid.executor import ExecContext
+
+    scope = scope or global_scope()
+    B, K, V = batch_size, spec.beam_size, None
+    N = B * K
+    NEG = -1e30
+
+    # state: per-beam memories [N, size]
+    mems = {}
+    for m in spec.mems:
+        if m["boot"] is not None:
+            boot = np.asarray(boot_values[m["var"].name])
+        else:
+            boot = np.zeros((B, m["size"]), np.float32)
+        mems[m["var"].name] = np.repeat(boot, K, axis=0)
+
+    statics = {}
+    for name in spec.statics:
+        v = static_values[name]
+        if isinstance(v, RaggedTensor):
+            statics[name] = _ragged_repeat(v, K)
+        else:
+            statics[name] = np.repeat(np.asarray(v), K, axis=0)
+
+    # params + anything persistable
+    base_env = {n: scope.get(n) for n in scope.local_var_names()
+                if scope.get(n) is not None}
+
+    # params created only by the generation topology (built after the
+    # training startup ran) initialize into a throwaway scope so trained
+    # weights are never clobbered
+    block_desc = spec.program.desc.block(spec.block_idx)
+    needed = set()
+    for od in block_desc.ops:
+        needed.update(od.input_names())
+    missing = [n for n in needed
+               if n not in base_env
+               and _is_persistable(spec.program, spec.block_idx, n)]
+    if missing:
+        from ..core.scope import Scope
+        from ..fluid.executor import Executor, CPUPlace
+
+        tmp = Scope()
+        Executor(CPUPlace()).run(framework.default_startup_program(),
+                                 scope=tmp)
+        for n in missing:
+            v = tmp.get(n)
+            if v is None:
+                raise KeyError(
+                    "generation step needs %r but it is neither in the "
+                    "scope nor produced by the startup program" % n)
+            scope.set(n, v)
+            base_env[n] = v
+
+    base_env.update(statics)
+
+    prev = np.full((N, 1), spec.bos_id, np.int64)
+    scores = np.tile(
+        np.concatenate([np.zeros(1, np.float32),
+                        np.full(K - 1, NEG, np.float32)]), B)
+    done = np.zeros(N, bool)
+    tok_hist, parent_hist = [], []
+
+    rng = jax.random.PRNGKey(rng_seed)
+    for t in range(spec.max_length):
+        env = dict(base_env)
+        env.update(mems)
+        env[spec.prev_ids_name] = jnp.asarray(prev)
+        ctx = ExecContext(None, spec.program, spec.block_idx, env,
+                          rng=rng)
+        ctx.run_block(spec.block_idx, env)
+        rng = ctx.rng
+
+        probs = np.asarray(env[spec.probs_name]).reshape(N, -1)
+        V = probs.shape[1]
+        logp = np.log(np.maximum(probs, 1e-30))
+        eos_only = np.full((V,), NEG, np.float32)
+        eos_only[spec.eos_id] = 0.0
+        logp = np.where(done[:, None], eos_only[None, :], logp)
+        total = (scores[:, None] + logp).reshape(B, K * V)
+        top_idx = np.argsort(-total, axis=1)[:, :K]        # [B, K]
+        top_scores = np.take_along_axis(total, top_idx, axis=1)
+        beam_idx = top_idx // V
+        tok_idx = (top_idx % V).astype(np.int64)
+        flat_src = (np.arange(B)[:, None] * K + beam_idx).reshape(-1)
+
+        for m in spec.mems:
+            nm = m["var"].name
+            new = np.asarray(env[m["new"]]).reshape(N, -1)
+            mems[nm] = new[flat_src]
+        prev = tok_idx.reshape(N, 1)
+        scores = top_scores.reshape(-1)
+        done = done[flat_src] | (prev.reshape(-1) == spec.eos_id)
+        tok_hist.append(tok_idx)
+        parent_hist.append(beam_idx)
+        if done.all():
+            break
+
+    # backtrack parents (reference: beam_search_decode PackAllSteps)
+    T = len(tok_hist)
+    beams = np.tile(np.arange(K)[None, :], (B, 1))
+    rev = []
+    for t in range(T - 1, -1, -1):
+        rev.append(np.take_along_axis(tok_hist[t], beams, axis=1))
+        beams = np.take_along_axis(parent_hist[t], beams, axis=1)
+    seqs = np.stack(rev[::-1], axis=2) if rev else \
+        np.zeros((B, K, 0), np.int64)                    # [B, K, T]
+
+    final = scores.reshape(B, K)
+    order = np.argsort(-final, axis=1)
+    final = np.take_along_axis(final, order, axis=1)
+    seqs = np.take_along_axis(seqs, order[:, :, None], axis=1)
+
+    R = spec.num_results_per_sample
+    id_stream = []
+    for b in range(B):
+        for r in range(R):
+            ids = [spec.bos_id]
+            for t in range(seqs.shape[2]):
+                w = int(seqs[b, r, t])
+                ids.append(w)
+                if w == spec.eos_id:
+                    break
+            if ids[-1] != spec.eos_id:
+                ids.append(spec.eos_id)
+            id_stream.extend(ids)
+            id_stream.append(-1)
+    return final[:, :R], id_stream

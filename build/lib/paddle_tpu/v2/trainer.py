@@ -1,0 +1,126 @@
+"""v2 SGD trainer: event-driven train loop over the fluid executor
+(reference: python/paddle/v2/trainer.py — SGD:37, train:137-215; there
+it drives a GradientMachine through SWIG, here it drives a compiled
+fluid Program)."""
+
+import numpy as np
+
+from .. import fluid
+from ..fluid import framework
+from . import event as v2_event
+from . import layer as v2_layer
+from .config import _place
+
+__all__ = ["SGD"]
+
+
+class SGD:
+    """reference: v2/trainer.py SGD — cost topology + parameters +
+    update_equation."""
+
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True):
+        self._cost = cost
+        self._parameters = parameters
+        self._extra = extra_layers or []
+        self._main_program = framework.default_main_program()
+
+        opt = update_equation
+        if hasattr(opt, "to_fluid"):
+            opt = opt.to_fluid()
+        self._optimizer = opt
+        self._optimize_ops, self._params_grads = opt.minimize(cost)
+        exe = fluid.Executor(_place())
+        self._run_startup_for_missing(exe)
+        self._exe = exe
+
+    @staticmethod
+    def _run_startup_for_missing(exe):
+        """Initialize only variables that have no value yet, so weights
+        loaded via Parameters before trainer construction survive
+        (minimize() adds optimizer accumulators that still need init)."""
+        from ..core import scope as scope_mod
+
+        startup = framework.default_startup_program()
+        scope = scope_mod.global_scope()
+        pending = framework.Program()
+        dst = pending.global_block()
+        needed = False
+        src = startup.global_block()
+        for op in src.desc.ops:
+            out_names = [n for ns in op.outputs.values() for n in ns]
+            if all(scope.get(n) is not None for n in out_names):
+                continue
+            for name in out_names:
+                if name not in dst.vars and name in src.vars:
+                    v = src.vars[name]
+                    dst.create_var(
+                        name=v.name, shape=v.shape, dtype=v.dtype,
+                        type=v.type, persistable=v.persistable,
+                        lod_level=v.lod_level)
+            dst.append_op(type=op.type, inputs=dict(op.inputs),
+                          outputs=dict(op.outputs),
+                          attrs=dict(op.attrs), infer_shape=False)
+            needed = True
+        if needed:
+            exe.run(pending)
+
+    def _feeder(self, feeding):
+        return fluid.DataFeeder(
+            feed_list=v2_layer.data_layers_for_feeding(
+                feeding, self._main_program),
+            place=_place())
+
+    def train(self, reader, num_passes=1, event_handler=None,
+              feeding=None, save_dir=None):
+        """save_dir: when set, parameters are written to
+        `save_dir/pass_NNNNN.tar` after every pass — the paddle_trainer
+        `--save_dir` behavior (reference: trainer/ParamUtil.h
+        saveParameters per pass), on top of the event_handler hook."""
+        if event_handler is None:
+            event_handler = lambda e: None
+        feeder = self._feeder(feeding)
+        fetch = [self._cost] + list(self._extra)
+
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_costs = []
+            for batch_id, data in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                outs = self._exe.run(self._main_program,
+                                     feed=feeder.feed(data),
+                                     fetch_list=fetch)
+                cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                pass_costs.append(cost)
+                event_handler(v2_event.EndForwardBackward(
+                    pass_id, batch_id))
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, cost))
+            if save_dir is not None:
+                import os
+
+                os.makedirs(save_dir, exist_ok=True)
+                path = os.path.join(save_dir, "pass_%05d.tar" % pass_id)
+                # tmp + rename: a crash mid-write must not leave a
+                # truncated tar at the final name
+                with open(path + ".tmp", "wb") as f:
+                    self._parameters.to_tar(f)
+                os.replace(path + ".tmp", path)
+            event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader, feeding=None):
+        """Run the cost over a reader without updating parameters
+        (reference: v2/trainer.py test — forward only; the program is
+        pruned to the cost so backward/optimizer ops don't run)."""
+        from ..fluid import io as fluid_io
+
+        test_program = fluid_io.prune_program(self._main_program,
+                                              [self._cost])
+        feeder = self._feeder(feeding)
+        total, n = 0.0, 0
+        for data in reader():
+            outs = self._exe.run(test_program, feed=feeder.feed(data),
+                                 fetch_list=[self._cost])
+            total += float(np.asarray(outs[0]).reshape(-1)[0]) * len(data)
+            n += len(data)
+        return v2_event.TestResult(cost=total / max(n, 1))

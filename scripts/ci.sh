@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# CI pipeline (reference: the Travis + docker build flow,
+# paddle/scripts/travis + docker/build.sh): style-ish checks, native
+# build, full test suite, both driver entry points, and a wheel.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "[ci] compile check (syntax across the tree) ..."
+python -m compileall -q paddle_tpu tests examples bench.py \
+    __graft_entry__.py
+
+echo "[ci] native runtime build ..."
+make -C native
+
+echo "[ci] full test suite ..."
+python -m pytest tests/ -q
+
+echo "[ci] driver entry points ..."
+BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
+    python bench.py
+timeout 600 env JAX_PLATFORMS=axon XLA_FLAGS= \
+    python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+echo "[ci] wheel build ..."
+# --no-build-isolation: build with the env's setuptools (works offline)
+pip wheel --no-deps --no-build-isolation -w dist/ . >/dev/null
+ls -l dist/*.whl
+
+echo "[ci] green"
