@@ -134,6 +134,104 @@ def test_dot_product_and_multi_head_attention():
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
 
 
+def test_attention_nmt_train_then_beam_generate():
+    """The NMT chapter's full loop through the v2 DSL: train the
+    attention decoder with teacher forcing, then beam-search GENERATE
+    with the same parameters — simple_attention runs inside the
+    generation step over the beam-expanded encoder sequence (reference:
+    demo/seqToseq gen flow over RecurrentGradientMachine::beamSearch)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.v2.attr import Param
+
+    E = 6
+    names = {"semb": "att_src_emb", "temb": "att_trg_emb",
+             "proj": "att_enc_proj", "boot": "att_boot",
+             "gates": "att_gates", "gru": "att_gru", "out": "att_out",
+             "transform": "att_transform", "score": "att_score"}
+
+    src = layer.data(name="src",
+                     type=v2.data_type.integer_value_sequence(V))
+
+    def encode(seq_in):
+        emb = layer.embedding(input=seq_in, size=E,
+                              param_attr=Param(name=names["semb"]))
+        enc = networks.simple_gru(input=emb, size=H)
+        proj = layer.fc(input=enc, size=H, bias_attr=False,
+                        param_attr=Param(name=names["proj"]))
+        boot = layer.fc(input=layer.last_seq(input=enc), size=H,
+                        act=v2.activation.Tanh(),
+                        param_attr=Param(name=names["boot"]))
+        return enc, proj, boot
+
+    def decoder_step(cur_emb, enc_seq, enc_p, boot):
+        mem = layer.memory(name="att_dec", size=H, boot_layer=boot)
+        ctx = networks.simple_attention(
+            encoded_sequence=enc_seq, encoded_proj=enc_p,
+            decoder_state=mem, name="att_head",
+            transform_param_attr=Param(name=names["transform"]),
+            softmax_param_attr=Param(name=names["score"]))
+        gates = layer.fc(input=layer.concat(input=[cur_emb, ctx]),
+                         size=H * 3, bias_attr=False,
+                         param_attr=Param(name=names["gates"]))
+        h = networks.gru_unit(input=gates, size=H, name="att_dec",
+                              gru_param_attr=Param(name=names["gru"]),
+                              gru_bias_attr=Param(name=names["gru"]
+                                                  + ".b"))
+        return layer.fc(input=h, size=V, act=v2.activation.Softmax(),
+                        param_attr=Param(name=names["out"]),
+                        bias_attr=Param(name=names["out"] + ".b"))
+
+    # --- training graph (teacher forcing) ---
+    enc, enc_proj, boot = encode(src)
+    trg = layer.data(name="trg",
+                     type=v2.data_type.integer_value_sequence(V))
+    nxt = layer.data(name="nxt",
+                     type=v2.data_type.integer_value_sequence(V))
+    trg_emb = layer.embedding(input=trg, size=E,
+                              param_attr=Param(name=names["temb"]))
+    probs = layer.recurrent_group(
+        step=lambda cur, es, ep: decoder_step(cur, es, ep, boot),
+        input=[trg_emb,
+               layer.StaticInput(input=enc, is_seq=True),
+               layer.StaticInput(input=enc_proj, is_seq=True)])
+    cost = layer.classification_cost(input=probs, label=nxt)
+
+    # task: whatever the source, emit "2 3 eos(1)" after bos(0)
+    data = [([2, 3, 4], [0, 2, 3], [2, 3, 1]),
+            ([5, 4], [0, 2, 3], [2, 3, 1])] * 3
+    losses = _train(cost, _feed(["src", "trg", "nxt"], data), 60)
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    # --- generation graph: same parameter names, beam decode ---
+    beam = layer.beam_search(
+        step=lambda cur, es, ep, b: decoder_step(cur, es, ep, b),
+        input=[layer.GeneratedInput(size=V,
+                                    embedding_name=names["temb"],
+                                    embedding_size=E),
+               layer.StaticInput(input=enc, is_seq=True),
+               layer.StaticInput(input=enc_proj, is_seq=True),
+               layer.StaticInput(input=boot)],
+        bos_id=0, eos_id=1, beam_size=3, max_length=6)
+
+    gen_probs, ids = paddle.infer(
+        output_layer=beam, input=[([2, 3, 4],), ([5, 4],)],
+        field=["prob", "id"])
+    seqs, cur = [], []
+    for w in ids:
+        if w == -1:
+            seqs.append(cur)
+            cur = []
+        else:
+            cur.append(int(w))
+    assert len(seqs) == 6  # 2 samples x beam 3
+    for s in seqs:
+        assert s[0] == 0
+    # the trained model's best beam per sample is the taught sequence
+    best = [seqs[0], seqs[3]]
+    for s in best:
+        assert s == [0, 2, 3, 1], (s, seqs)
+
+
 def test_small_vgg_builds_and_steps():
     """small_vgg (CIFAR shape): one training step, finite loss."""
     img = layer.data(name="img",
