@@ -286,7 +286,8 @@ def main():
 
     for _ in range(warmup):
         fetches, state = step(state, feeds)
-    jax.block_until_ready(fetches)
+    if warmup:
+        jax.block_until_ready(fetches)
 
     t0 = time.perf_counter()
     for _ in range(iters):
