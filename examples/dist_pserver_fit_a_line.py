@@ -73,7 +73,7 @@ def main():
         __file__)))
     child_pythonpath = os.pathsep.join(
         p for p in (repo_root, os.environ.get("PYTHONPATH")) if p)
-    ps_procs, tr_procs = launch(
+    ps_procs, tr_procs, _ = launch(
         [os.path.abspath(__file__)],
         pservers=["127.0.0.1:7164", "127.0.0.1:7165"],
         trainers=2, sync=True,

@@ -16,8 +16,10 @@ from .transpiler import (DistributeTranspiler, split_dense_variable,
                          run_pserver)
 
 from .coordinator import (init_multihost, global_mesh, process_count,
-                          process_index, ElasticRegistry, ServiceLease)
+                          process_index, ElasticRegistry, ServiceLease,
+                          discover_pservers)
 
 __all__ = ["DistributeTranspiler", "split_dense_variable", "run_pserver",
            "init_multihost", "global_mesh", "process_count",
-           "process_index", "ElasticRegistry", "ServiceLease"]
+           "process_index", "ElasticRegistry", "ServiceLease",
+           "discover_pservers"]

@@ -30,7 +30,25 @@ import threading
 import time
 
 __all__ = ["init_multihost", "global_mesh", "process_count",
-           "process_index", "ElasticRegistry", "ServiceLease"]
+           "process_index", "ElasticRegistry", "ServiceLease",
+           "discover_pservers"]
+
+
+def discover_pservers(count=None, timeout=60.0, master=None):
+    """Trainer-side pserver discovery through the registry (reference:
+    go/pserver/client/etcd_client.go — trainers watch etcd for the
+    pserver set).  Reads PADDLE_MASTER (host:port) and
+    PADDLE_PSERVER_COUNT when args are omitted; returns endpoints
+    ordered by slot after the desired-count rendezvous."""
+    master = master or os.environ["PADDLE_MASTER"]
+    if count is None:
+        count = int(os.environ["PADDLE_PSERVER_COUNT"])
+    host, port = master.rsplit(":", 1)
+    reg = ElasticRegistry(host, int(port))
+    try:
+        return reg.wait_for_pservers(count, timeout=timeout)
+    finally:
+        reg.close()
 
 _initialized = [False]
 

@@ -15,7 +15,7 @@ Key departures from the reference, by design:
     reference has special semantics: dropout masks, sparse lookup_table
     grads, control flow).
   * shape inference defaults to `jax.eval_shape` over the kernel with a
-    two-sample prime substitution for dynamic (-1) dims, replacing the
+    two-sample substitution for dynamic (-1) dims, replacing the
     hand-written per-op InferShape functions (reference:
     framework/shape_inference.h) for most ops.
 """
@@ -112,11 +112,19 @@ def forward_type_of_grad(type):
 # Generic shape inference
 # ---------------------------------------------------------------------------
 
-# all dynamic (-1) dims substitute the SAME prime within one inference run
-# (they are almost always the batch/token dim and must broadcast together);
-# two runs with different primes tell static dims from dynamic ones.
-_PRIME_A = 97
-_PRIME_B = 101
+# all dynamic (-1) dims substitute the SAME value within one inference
+# run (they are almost always the batch/token dim and must broadcast
+# together); two runs with different values tell static dims from
+# dynamic ones.  The substitutes are highly composite (840 = lcm 1..8,
+# 2520 = lcm 1..9) rather than prime so kernels that FOLD the dynamic
+# dim — reshape [-1, heads, ...] in multi-head attention, microbatch
+# splits — see a divisible size.  Trade-off vs the old coprime primes:
+# an output dim computed as a REMAINDER by a common divisor of both
+# substitutes collapses to the same value in both runs and would be
+# misread as static; no kernel does that today, and fold/split
+# divisibility matters more than collision resistance here.
+_SUB_A = 840
+_SUB_B = 2520
 
 
 class _NullCtx:
@@ -132,13 +140,14 @@ class _NullCtx:
             "ops with sub-blocks need an explicit infer_shape")
 
 
-def _abstract_inputs(ins_meta, prime):
+def _abstract_inputs(ins_meta, sub_val):
     """ins_meta: slot -> list of (shape, dtype, lod_level[, var_type]).
-    Returns abstract values with every -1 dim substituted by `prime`."""
+    Returns abstract values with every -1 dim substituted by
+    `sub_val`."""
     from ..core.ragged import RaggedTensor, SelectedRows
 
     def sub(shape):
-        return tuple(prime if (d is None or d < 0) else int(d)
+        return tuple(sub_val if (d is None or d < 0) else int(d)
                      for d in shape)
 
     abstract = {}
@@ -150,16 +159,16 @@ def _abstract_inputs(ins_meta, prime):
             if vtype == VarType.SELECTED_ROWS:
                 # rows count is dynamic; height = shape[0] is static
                 height = int(shape[0]) if shape and shape[0] and \
-                    shape[0] > 0 else prime
+                    shape[0] > 0 else sub_val
                 sr = SelectedRows.tree_unflatten(height, (
-                    jax.ShapeDtypeStruct((prime,), jnp.int32),
-                    jax.ShapeDtypeStruct((prime,) + sub(shape)[1:],
+                    jax.ShapeDtypeStruct((sub_val,), jnp.int32),
+                    jax.ShapeDtypeStruct((sub_val,) + sub(shape)[1:],
                                          np_dtype(dtype))))
                 vals.append(sr)
                 continue
             sds = jax.ShapeDtypeStruct(sub(shape), np_dtype(dtype))
             if lod_level and lod_level > 0:
-                splits = [jax.ShapeDtypeStruct((prime + 1,), jnp.int32)
+                splits = [jax.ShapeDtypeStruct((sub_val + 1,), jnp.int32)
                           for _ in range(lod_level)]
                 rt = RaggedTensor.tree_unflatten(
                     lod_level,
@@ -173,12 +182,12 @@ def _abstract_inputs(ins_meta, prime):
 
 def generic_infer_shape(op_type, ins_meta, attrs):
     """Infer output (shape, dtype, lod_level) per slot.  Dims that differ
-    between the two prime substitutions are reported as -1 (dynamic)."""
+    between the two substitutions are reported as -1 (dynamic)."""
     info = get_op_info(op_type)
     kernel = info.kernel
 
-    def run(prime):
-        abstract = _abstract_inputs(ins_meta, prime)
+    def run(sub_val):
+        abstract = _abstract_inputs(ins_meta, sub_val)
         return jax.eval_shape(lambda i: kernel(_NullCtx(), i, attrs), abstract)
 
     has_dynamic = any(
@@ -190,8 +199,8 @@ def generic_infer_shape(op_type, ins_meta, attrs):
                         meta[3] == VarType.SELECTED_ROWS)
         for metas in ins_meta.values() for meta in metas)
 
-    out_a = run(_PRIME_A)
-    out_b = run(_PRIME_B) if has_dynamic else out_a
+    out_a = run(_SUB_A)
+    out_b = run(_SUB_B) if has_dynamic else out_a
 
     from ..core.ragged import RaggedTensor, SelectedRows
 

@@ -23,17 +23,48 @@ __all__ = ["launch", "main"]
 
 
 def launch(script_argv, pservers, trainers, sync=True, env=None,
-           python=sys.executable):
+           python=sys.executable, elastic=False):
     """Spawn len(pservers) pserver processes + `trainers` trainer
-    processes; returns (pserver_procs, trainer_procs)."""
+    processes; returns (pserver_procs, trainer_procs[, master]).
+
+    Returns (pserver_procs, trainer_procs, master); `master` is None
+    unless elastic.
+
+    elastic=True runs the reference's etcd-style flow instead of static
+    endpoints: a master process carries the TTL-lease registry, each
+    pserver binds a free port and registers its slot with heartbeats,
+    trainers discover the live set via
+    `distributed.discover_pservers()` (PADDLE_MASTER /
+    PADDLE_PSERVER_COUNT env).  `pservers` then only sets the COUNT;
+    the endpoints in it are ignored."""
     base_env = dict(os.environ)
     base_env.update(env or {})
-    base_env["PSERVERS"] = ",".join(pservers)
     base_env["TRAINERS"] = str(trainers)
     base_env["PADDLE_SYNC"] = "1" if sync else "0"
 
-    ps_procs = []
-    for ep in pservers:
+    master = None
+    if elastic:
+        from .. import native
+
+        master = native.Master()
+        base_env["PADDLE_MASTER"] = "127.0.0.1:%d" % master.port
+        base_env["PADDLE_PSERVER_COUNT"] = str(len(pservers))
+        code = (
+            "import os,signal;"
+            "from paddle_tpu import native;"
+            "from paddle_tpu.distributed import ElasticRegistry;"
+            "s=native.ParameterServer(port=0,"
+            "num_trainers=int(os.environ['TRAINERS']),"
+            "sync=os.environ['PADDLE_SYNC']=='1');"
+            "host,port=os.environ['PADDLE_MASTER'].rsplit(':',1);"
+            "reg=ElasticRegistry(host,int(port));"
+            "slot,lease=reg.register_pserver("
+            "'127.0.0.1:%d'%s.port,"
+            "int(os.environ['PADDLE_PSERVER_COUNT']));"
+            "print('pserver ready slot',slot,flush=True);"
+            "signal.pause()")
+    else:
+        base_env["PSERVERS"] = ",".join(pservers)
         code = ("import os,sys,signal;"
                 "from paddle_tpu.distributed import run_pserver;"
                 "s=run_pserver(os.environ['PSERVER_ENDPOINT'],"
@@ -41,17 +72,27 @@ def launch(script_argv, pservers, trainers, sync=True, env=None,
                 "sync=os.environ['PADDLE_SYNC']=='1');"
                 "print('pserver ready', flush=True);"
                 "signal.pause()")
-        ps_procs.append(subprocess.Popen(
-            [python, "-c", code],
-            env={**base_env, "TRAINING_ROLE": "PSERVER",
-                 "PSERVER_ENDPOINT": ep},
-            stdout=subprocess.PIPE, text=True))
-    # trainers have no connect retry: wait until every pserver has
-    # bound its port before spawning them
-    for p in ps_procs:
-        line = p.stdout.readline()
-        if "ready" not in line:
-            raise RuntimeError("pserver failed to start: %r" % line)
+
+    ps_procs = []
+    try:
+        for ep in pservers:
+            ps_procs.append(subprocess.Popen(
+                [python, "-c", code],
+                env={**base_env, "TRAINING_ROLE": "PSERVER",
+                     "PSERVER_ENDPOINT": ep},
+                stdout=subprocess.PIPE, text=True))
+        # trainers have no connect retry: wait until every pserver has
+        # bound its port (and, elastic, registered) before spawning them
+        for p in ps_procs:
+            line = p.stdout.readline()
+            if "ready" not in line:
+                raise RuntimeError("pserver failed to start: %r" % line)
+    except BaseException:
+        for p in ps_procs:
+            p.kill()
+        if master is not None:
+            master.stop()
+        raise
 
     tr_procs = []
     for tid in range(trainers):
@@ -59,7 +100,7 @@ def launch(script_argv, pservers, trainers, sync=True, env=None,
             [python] + list(script_argv),
             env={**base_env, "TRAINING_ROLE": "TRAINER",
                  "TRAINER_ID": str(tid)}))
-    return ps_procs, tr_procs
+    return ps_procs, tr_procs, master
 
 
 def main(argv=None):
@@ -69,6 +110,9 @@ def main(argv=None):
     ap.add_argument("--trainers", type=int, default=1)
     ap.add_argument("--async", dest="sync", action="store_false",
                     help="async SGD (reference: asyncSGD)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="etcd-style flow: master registry + pserver "
+                         "slot registration + trainer discovery")
     ap.add_argument("script", nargs=argparse.REMAINDER,
                     help="trainer script + args")
     args = ap.parse_args(argv)
@@ -76,8 +120,9 @@ def main(argv=None):
         ap.error("missing trainer script")
 
     pservers = args.pservers.split(",")
-    ps_procs, tr_procs = launch(args.script, pservers, args.trainers,
-                                sync=args.sync)
+    ps_procs, tr_procs, master = launch(
+        args.script, pservers, args.trainers, sync=args.sync,
+        elastic=args.elastic)
     rc = 0
     try:
         for p in tr_procs:
@@ -87,6 +132,8 @@ def main(argv=None):
             p.send_signal(signal.SIGTERM)
         for p in ps_procs:
             p.wait()
+        if master is not None:
+            master.stop()
     return rc
 
 

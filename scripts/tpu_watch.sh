@@ -19,8 +19,29 @@ probe_timeout="${WATCH_PROBE_TIMEOUT:-7200}"
 
 say() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$log"; }
 
-bench_one() {  # bench_one <label> [ENV=VAL ...]
-  local label="$1"; shift
+watch_start_epoch="$(date +%s)"
+
+has_record() {  # has_record <metric|amp key> — fresh this watch run
+  python - "$1" "$watch_start_epoch" <<'PY'
+import json, sys
+try:
+    store = json.load(open("BENCH_LAST_TPU.json"))
+except Exception:
+    sys.exit(1)
+rec = store.get(sys.argv[1])
+# only skip for records measured AFTER this watcher started: a stale
+# store from an earlier round must never satisfy the suite
+ok = rec is not None and rec.get("measured_at", 0) >= float(sys.argv[2])
+sys.exit(0 if ok else 1)
+PY
+}
+
+bench_one() {  # bench_one <label> <record-key> [ENV=VAL ...]
+  local label="$1" key="$2"; shift 2
+  if has_record "$key"; then
+    say "bench $label already captured — skipping"
+    return 0
+  fi
   say "bench $label ..."
   if env BENCH_CLAIM_TIMEOUT=0 "$@" timeout 2400 python bench.py \
       >>"$log" 2>&1; then
@@ -39,15 +60,23 @@ while true; do
       "import jax; print(jax.devices(), flush=True)" >>"$log" 2>&1; then
     say "claim OK — capturing measurement suite"
     ok=1
-    bench_one "resnet50-b128" BENCH_MODEL=resnet50 BENCH_BATCH=128 || ok=0
-    bench_one "resnet50-b256" BENCH_MODEL=resnet50 BENCH_BATCH=256 || ok=0
-    bench_one "vgg16-b128"    BENCH_MODEL=vgg16 BENCH_BATCH=128 || ok=0
-    bench_one "lstm-b256-h256" BENCH_MODEL=lstm BENCH_BATCH=256 \
-      BENCH_HIDDEN=256 || ok=0
-    bench_one "alexnet-b128"  BENCH_MODEL=alexnet BENCH_BATCH=128 || ok=0
-    bench_one "googlenet-b128" BENCH_MODEL=googlenet BENCH_BATCH=128 || ok=0
-    bench_one "resnet50-b128-f32" BENCH_MODEL=resnet50 BENCH_BATCH=128 \
-      BENCH_AMP=0 || ok=0
+    bench_one "resnet50-b128" "resnet50_train_imgs_per_sec_batch128|bf16" \
+      BENCH_MODEL=resnet50 BENCH_BATCH=128 || ok=0
+    bench_one "resnet50-b256" "resnet50_train_imgs_per_sec_batch256|bf16" \
+      BENCH_MODEL=resnet50 BENCH_BATCH=256 || ok=0
+    bench_one "vgg16-b128" "vgg16_train_imgs_per_sec_batch128|bf16" \
+      BENCH_MODEL=vgg16 BENCH_BATCH=128 || ok=0
+    bench_one "lstm-b256-h256" \
+      "lstm_train_samples_per_sec_batch256_hidden256|bf16" \
+      BENCH_MODEL=lstm BENCH_BATCH=256 BENCH_HIDDEN=256 || ok=0
+    bench_one "alexnet-b128" "alexnet_train_imgs_per_sec_batch128|bf16" \
+      BENCH_MODEL=alexnet BENCH_BATCH=128 || ok=0
+    bench_one "googlenet-b128" \
+      "googlenet_train_imgs_per_sec_batch128|bf16" \
+      BENCH_MODEL=googlenet BENCH_BATCH=128 || ok=0
+    bench_one "resnet50-b128-f32" \
+      "resnet50_train_imgs_per_sec_batch128|f32" \
+      BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_AMP=0 || ok=0
     say "profiling ..."
     env PROFILE_STEPS=10 timeout 2400 python scripts/profile_tpu.py \
       >>"$log" 2>&1 && say "profile OK" || say "profile FAILED"

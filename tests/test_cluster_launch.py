@@ -55,7 +55,7 @@ def test_cluster_launch_end_to_end(tmp_path):
             ports.append(sk.getsockname()[1])
     pservers = ["127.0.0.1:%d" % p for p in ports]
 
-    ps_procs, tr_procs = launch(
+    ps_procs, tr_procs, _ = launch(
         [str(script)], pservers, trainers=2, sync=True,
         env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu"})
     try:
@@ -68,3 +68,35 @@ def test_cluster_launch_end_to_end(tmp_path):
             p.send_signal(signal.SIGTERM)
         for p in ps_procs:
             p.wait(timeout=30)
+
+
+ELASTIC_TRAIN_SCRIPT = TRAIN_SCRIPT.replace(
+    'pservers=os.environ["PSERVERS"],',
+    'pservers=",".join(__import__("paddle_tpu.distributed",'
+    ' fromlist=["discover_pservers"]).discover_pservers()),')
+
+
+def test_cluster_launch_elastic(tmp_path):
+    """--elastic flow: launcher starts a master registry, pservers bind
+    free ports and register slots, trainers DISCOVER the endpoints
+    instead of reading a static list (reference: the etcd-driven
+    go/pserver cluster bring-up)."""
+    script = tmp_path / "train_dist_elastic.py"
+    script.write_text(ELASTIC_TRAIN_SCRIPT)
+
+    # endpoints are placeholders in elastic mode: only the count is used
+    ps_procs, tr_procs, master = launch(
+        [str(script)], ["x:0", "x:0"], trainers=2, sync=True,
+        elastic=True,
+        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu"})
+    try:
+        rcs = [p.wait(timeout=240) for p in tr_procs]
+        assert rcs == [0, 0], rcs
+    finally:
+        import signal
+
+        for p in ps_procs:
+            p.send_signal(signal.SIGTERM)
+        for p in ps_procs:
+            p.wait(timeout=30)
+        master.stop()

@@ -1,0 +1,69 @@
+"""fluid.nets.scaled_dot_product_attention numeric check against a
+numpy reference (reference: the nets-module attention composite;
+multi-head folding must reproduce per-head softmax(QK^T/sqrt(d))V)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import nets
+
+
+def _np_attention(q, k, v, heads):
+    b, tq, d = q.shape
+    tk = k.shape[1]
+    hd, hv = d // heads, v.shape[-1] // heads
+    out = np.empty((b, tq, v.shape[-1]), np.float32)
+    for i in range(b):
+        for h in range(heads):
+            qs = q[i, :, h * hd:(h + 1) * hd]
+            ks = k[i, :, h * hd:(h + 1) * hd]
+            vs = v[i, :, h * hv:(h + 1) * hv]
+            s = qs @ ks.T / np.sqrt(hd)
+            e = np.exp(s - s.max(-1, keepdims=True))
+            w = e / e.sum(-1, keepdims=True)
+            out[i, :, h * hv:(h + 1) * hv] = w @ vs
+    return out
+
+
+def test_scaled_dot_product_attention_matches_numpy():
+    b, tq, tk, d, heads = 2, 3, 5, 8, 2
+    rs = np.random.RandomState(0)
+    qn = rs.randn(b, tq, d).astype(np.float32)
+    kn = rs.randn(b, tk, d).astype(np.float32)
+    vn = rs.randn(b, tk, d).astype(np.float32)
+
+    q = fluid.layers.data(name="q", shape=[b, tq, d], dtype="float32",
+                          append_batch_size=False)
+    k = fluid.layers.data(name="k", shape=[b, tk, d], dtype="float32",
+                          append_batch_size=False)
+    v = fluid.layers.data(name="v", shape=[b, tk, d], dtype="float32",
+                          append_batch_size=False)
+    for heads_n in (1, heads):
+        ctx = nets.scaled_dot_product_attention(q, k, v,
+                                                num_heads=heads_n)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        out, = exe.run(fluid.default_main_program(),
+                       feed={"q": qn, "k": kn, "v": vn},
+                       fetch_list=[ctx])
+        np.testing.assert_allclose(np.asarray(out),
+                                   _np_attention(qn, kn, vn, heads_n),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_scaled_dot_product_attention_dynamic_batch():
+    """The default data-layer spelling (append_batch_size=True, batch
+    dim -1) must work: every internal reshape carries a single -1."""
+    tq, d, heads = 3, 8, 2
+    rs = np.random.RandomState(1)
+    xn = rs.randn(4, tq, d).astype(np.float32)
+
+    x = fluid.layers.data(name="x", shape=[tq, d], dtype="float32")
+    ctx = nets.scaled_dot_product_attention(x, x, x, num_heads=heads)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(fluid.default_main_program(), feed={"x": xn},
+                   fetch_list=[ctx])
+    np.testing.assert_allclose(np.asarray(out),
+                               _np_attention(xn, xn, xn, heads),
+                               rtol=2e-5, atol=2e-6)
