@@ -6,6 +6,8 @@ conv2d:912, pool2d, batch_norm:1250, dropout, cross_entropy, accuracy …).
 Each function appends ops to the current block; nothing executes here.
 """
 
+import numpy as np
+
 from ..layer_helper import LayerHelper
 from ..framework import Variable
 from ..initializer import Constant, Normal, Xavier
@@ -230,10 +232,16 @@ def edit_distance(input, label, normalized=False, ignored_tokens=None,
 
 
 def ctc_greedy_decoder(input, blank, **kwargs):
-    """reference: ctc_align_op.cc (merge repeated, drop blanks)."""
+    """Greedy CTC decode of per-step class scores: argmax each step,
+    merge repeats, drop blanks (reference: the topk + ctc_align_op.cc
+    pair).  `input` is the ragged [T, num_classes] probs/logits
+    sequence; an int input is taken as already-argmaxed ids."""
     helper = LayerHelper("ctc_align", **kwargs)
+    ids = input
+    if not np.issubdtype(np.dtype(str(input.dtype)), np.integer):
+        _, ids = topk(input, 1)
     out = helper.create_tmp_variable(dtype="int32", stop_gradient=True)
-    helper.append_op(type="ctc_align", inputs={"Input": [input]},
+    helper.append_op(type="ctc_align", inputs={"Input": [ids]},
                      outputs={"Output": [out]},
                      attrs={"blank": blank, "merge_repeated": True})
     return out

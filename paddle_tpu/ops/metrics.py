@@ -18,14 +18,26 @@ def _vals(v):
              nondiff_inputs=("Out", "Indices", "Label"))
 def accuracy(ctx, ins, attrs):
     """ins: Out (top-k values, unused), Indices (top-k [N,k]), Label [N,1].
-    reference: accuracy_op.h AccuracyKernel."""
-    indices = _vals(ins["Indices"][0]).astype(jnp.int32)
-    label = _vals(ins["Label"][0]).astype(jnp.int32)
+    reference: accuracy_op.h AccuracyKernel.  Ragged inputs count VALID
+    rows only (bucket-padding rows must corrupt neither numerator nor
+    denominator)."""
+    ind_in = ins["Indices"][0]
+    indices = _vals(ind_in).astype(jnp.int32)
+    lab_in = ins["Label"][0]
+    label = _vals(lab_in).astype(jnp.int32)
     label = jnp.reshape(label, (-1, 1))
     hit = jnp.any(indices == label, axis=1)
-    num = jnp.asarray(indices.shape[0], jnp.int32)
+    ragged = next((v for v in (ind_in, lab_in)
+                   if isinstance(v, RaggedTensor)), None)
+    if ragged is not None:
+        mask = ragged.valid_mask()
+        hit = hit & mask
+        num = ragged.nvalid.astype(jnp.int32)
+    else:
+        num = jnp.asarray(indices.shape[0], jnp.int32)
     correct = jnp.sum(hit.astype(jnp.int32))
-    acc = correct.astype(jnp.float32) / num.astype(jnp.float32)
+    acc = correct.astype(jnp.float32) / jnp.maximum(num, 1) \
+        .astype(jnp.float32)
     return {"Accuracy": [jnp.reshape(acc, (1,))],
             "Correct": [jnp.reshape(correct, (1,))],
             "Total": [jnp.reshape(num, (1,))]}

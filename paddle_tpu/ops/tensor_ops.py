@@ -187,8 +187,13 @@ def clip_by_norm(ctx, ins, attrs):
 def top_k(ctx, ins, attrs):
     x = _x(ins)
     k = int(attrs["k"])
-    vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
+    vals, idx = jax.lax.top_k(_vals(x), k)
+    idx = idx.astype(jnp.int32)
+    if isinstance(x, RaggedTensor):
+        # per-step top-k of a sequence stays a sequence
+        return {"Out": [x.with_values(vals)],
+                "Indices": [x.with_values(idx)]}
+    return {"Out": [vals], "Indices": [idx]}
 
 
 @register_op("gather")
