@@ -1,0 +1,69 @@
+"""Inference export round-trip for a ragged (LoD) sequence model
+(reference: save_inference_model io.py:237 + InferenceEngine on the
+understand_sentiment LSTM — deploy-time inputs are variable-length
+sequences)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io as fluid_io
+
+
+def test_sequence_model_save_load_infer(tmp_path):
+    V, E, H = 40, 8, 8
+    words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                              lod_level=1)
+    emb = fluid.layers.embedding(input=words, size=[V, E])
+    lstm = fluid.layers.dynamic_lstm(
+        input=fluid.layers.fc(input=emb, size=4 * H), size=4 * H)[0]
+    pooled = fluid.layers.sequence_pool(input=lstm, pool_type="max")
+    probs = fluid.layers.fc(input=pooled, size=2, act="softmax")
+    # training-only tail that export must prune away
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    loss = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=probs, label=label))
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    rs = np.random.RandomState(0)
+    seqs = [rs.randint(0, V, size=(rs.randint(2, 7), 1)).astype(np.int64)
+            for _ in range(5)]
+    feeder = fluid.DataFeeder(place=place, feed_list=[words])
+    feed = feeder.feed([(s,) for s in seqs])
+
+    # a couple of train steps so exported params are non-initial
+    tfeeder = fluid.DataFeeder(place=place, feed_list=[words, label])
+    tfeed = tfeeder.feed([(s, np.asarray([i % 2], np.int64))
+                          for i, s in enumerate(seqs)])
+    for _ in range(3):
+        exe.run(fluid.default_main_program(), feed=tfeed,
+                fetch_list=[loss])
+
+    infer_prog = fluid_io.prune_program(fluid.default_main_program(),
+                                        [probs])
+    expect, = exe.run(infer_prog, feed=feed, fetch_list=[probs])
+
+    model_dir = str(tmp_path / "seq_model")
+    fluid_io.save_inference_model(model_dir, ["words"], [probs], exe)
+
+    # fresh scope + program: deploy-side reload
+    from paddle_tpu.core import scope as scope_mod
+
+    scope_mod.reset_global_scope()
+    exe2 = fluid.Executor(place)
+    prog, feed_names, fetch_vars = fluid_io.load_inference_model(
+        model_dir, exe2)
+    assert feed_names == ["words"]
+    # the pruned program must not carry the training tail
+    optypes = [op.type for op in prog.global_block().ops]
+    assert "adam" not in optypes and "cross_entropy" not in optypes
+
+    feeder2 = fluid.DataFeeder(place=place, feed_list=[feed_names[0]],
+                               program=prog)
+    got, = exe2.run(prog, feed=feeder2.feed([(s,) for s in seqs]),
+                    fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=1e-5, atol=1e-6)
