@@ -42,12 +42,12 @@ def test_sequence_model_save_load_infer(tmp_path):
         exe.run(fluid.default_main_program(), feed=tfeed,
                 fetch_list=[loss])
 
-    infer_prog = fluid_io.prune_program(fluid.default_main_program(),
-                                        [probs])
-    expect, = exe.run(infer_prog, feed=feed, fetch_list=[probs])
-
     model_dir = str(tmp_path / "seq_model")
-    fluid_io.save_inference_model(model_dir, ["words"], [probs], exe)
+    # save returns the exact pruned program it serialized — use it for
+    # the reference forward so the comparison covers what was exported
+    infer_prog = fluid_io.save_inference_model(model_dir, ["words"],
+                                               [probs], exe)
+    expect, = exe.run(infer_prog, feed=feed, fetch_list=[probs])
 
     # fresh scope + program: deploy-side reload
     from paddle_tpu.core import scope as scope_mod
