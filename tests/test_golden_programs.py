@@ -83,11 +83,24 @@ def _build_transpiled_pair():
                 for pname, blocks in t.param_blocks.items()}}
 
 
+def _build_deepfm():
+    from paddle_tpu.models.ctr import deepfm_ctr
+
+    ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+    avg_loss, _ = deepfm_ctr(ids, label, num_features=64, num_fields=4,
+                             embed_dim=4, hidden_sizes=(8,))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_loss)
+    # the IR must pin the SelectedRows typing of the sparse-table grads
+    return fluid.default_main_program().desc.to_dict()
+
+
 CASES = {
     "fit_a_line": lambda: _build_fit_a_line().desc.to_dict(),
     "conv_classifier": lambda: _build_conv_classifier().desc.to_dict(),
     "dynamic_rnn": lambda: _build_dynamic_rnn().desc.to_dict(),
     "transpiled_pair": _build_transpiled_pair,
+    "deepfm": _build_deepfm,
 }
 
 
