@@ -127,7 +127,8 @@ def test_golden_roundtrip():
     """The pinned descs still load and re-serialize identically."""
     from paddle_tpu.core.desc import ProgramDesc
 
-    for case in ("fit_a_line", "conv_classifier", "dynamic_rnn"):
+    for case in ("fit_a_line", "conv_classifier", "dynamic_rnn",
+                 "deepfm"):
         with open(os.path.join(GOLDEN_DIR, case + ".json")) as f:
             want = json.load(f)
         desc = ProgramDesc.from_dict(want)
