@@ -11,6 +11,8 @@ jax.numpy.dot/matmul so XLA tiles them onto the systolic array; the
 reference's cuBLAS wrapper layer has no equivalent here by design.
 """
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -121,14 +123,49 @@ def _reduce(name, fn, acc_f32=False):
             # bits saturate after a few hundred ~1.0 addends); max/min
             # reductions are exact in any dtype and skip this
             x = x.astype(jnp.float32)
+        dim = int(attrs.get("dim", 0))
+        if dim < 0:
+            dim += x.ndim
+        # a reduction that crosses the ragged ROW axis must not fold
+        # bucket-padding rows into the result (same contract as `mean`)
+        if isinstance(xr, RaggedTensor) and (attrs.get("reduce_all",
+                                                       False)
+                                             or dim == 0):
+            mask = xr.valid_mask().reshape(
+                (-1,) + (1,) * (x.ndim - 1))
+            if name == "reduce_sum":
+                x = jnp.where(mask, x, jnp.zeros_like(x))
+            elif name == "reduce_mean":
+                # masked sum / valid count, broadcast over features
+                total = jnp.sum(jnp.where(mask, x, jnp.zeros_like(x)),
+                                axis=None
+                                if attrs.get("reduce_all", False) else 0)
+                denom = jnp.maximum(xr.nvalid, 1).astype(total.dtype)
+                if attrs.get("reduce_all", False):
+                    feat = max(1, int(np.prod(x.shape[1:])))
+                    out = total / (denom * feat)
+                    out = jnp.reshape(out, (1,) * x.ndim
+                                      if attrs.get("keep_dim", False)
+                                      else (1,))
+                    return {"Out": [out]}
+                out = total / denom
+                if attrs.get("keep_dim", False):
+                    out = jnp.expand_dims(out, 0)
+                return {"Out": [out]}
+            else:
+                # dtype-aware identity element for max/min over pads
+                info = (jnp.iinfo(x.dtype)
+                        if jnp.issubdtype(x.dtype, jnp.integer)
+                        else jnp.finfo(x.dtype))
+                neutral = jnp.asarray(
+                    info.min if name == "reduce_max" else info.max,
+                    x.dtype)
+                x = jnp.where(mask, x, neutral)
         if attrs.get("reduce_all", False):
             out = fn(x, axis=None)
             out = jnp.reshape(out, (1,) * x.ndim
                               if attrs.get("keep_dim", False) else (1,))
             return {"Out": [out]}
-        dim = int(attrs.get("dim", 0))
-        if dim < 0:
-            dim += x.ndim
         out = fn(x, axis=dim)
         if attrs.get("keep_dim", False):
             out = jnp.expand_dims(out, dim)

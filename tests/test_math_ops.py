@@ -327,3 +327,41 @@ class TestCast(OpTest):
         self.attrs = {"out_dtype": "int32"}
         self.outputs = {"Out": x.astype("int32")}
         self.check_output()
+
+
+def test_ragged_reductions_mask_bucket_padding():
+    """Reductions crossing the ragged row axis count VALID rows only:
+    the feeder's bucket padding must not leak into sums/means/maxes
+    (same contract as the loss `mean`)."""
+    import paddle_tpu.fluid as fluid
+
+    x = fluid.layers.data(name="xr", shape=[2], dtype="float32",
+                          lod_level=1)
+    fetches = [fluid.layers.reduce_sum(x),
+               fluid.layers.reduce_mean(x),
+               fluid.layers.reduce_max(x),
+               fluid.layers.reduce_min(x),
+               fluid.layers.reduce_sum(x, dim=0)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x])
+    # all-negative max / all-positive min: unmasked ZERO padding rows
+    # would win either reduction, so these assertions probe the fill
+    feed = feeder.feed([([[-1, -2], [-3, -4]],), ([[-5, -6]],)])
+    s, m, mx, mn, s0 = exe.run(fluid.default_main_program(), feed=feed,
+                               fetch_list=fetches)
+    assert np.isclose(np.asarray(s).reshape(()), -21.0)
+    assert np.isclose(np.asarray(m).reshape(()), -3.5)
+    assert np.isclose(np.asarray(mx).reshape(()), -1.0)
+    assert np.isclose(np.asarray(mn).reshape(()), -6.0)
+    np.testing.assert_allclose(np.asarray(s0), [-9.0, -12.0])
+
+    xp = fluid.layers.data(name="xp", shape=[1], dtype="float32",
+                           lod_level=1)
+    mn_pos = fluid.layers.reduce_min(xp)
+    feedp = dict(feed)
+    feedp.update(fluid.DataFeeder(
+        place=fluid.CPUPlace(),
+        feed_list=[xp]).feed([([[2.0], [7.0]],)]))
+    got, = exe.run(fluid.default_main_program(), feed=feedp,
+                   fetch_list=[mn_pos])
+    assert np.isclose(np.asarray(got).reshape(()), 2.0)
