@@ -23,6 +23,7 @@ from . import clip
 from . import io
 from . import checkpoint
 from . import evaluator
+from . import lr_schedules
 from . import amp
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize

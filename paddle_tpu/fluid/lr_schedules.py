@@ -1,0 +1,169 @@
+"""Learning-rate schedules as in-program ops.
+
+Capability parity with the reference's LR scheduling (reference:
+paddle/parameter/LearningRateScheduler.cpp — poly/exp/discexp/linear
+schedules selected by TrainerConfig; surfaced in later fluid as
+layers.exponential_decay etc.).  Each schedule owns a persistable step
+counter incremented once per program run and computes the step's LR
+with elementwise ops, so the whole thing compiles into the train step
+— pass the returned Variable as any optimizer's `learning_rate`.
+
+    lr = fluid.lr_schedules.exponential_decay(0.1, decay_steps=100,
+                                              decay_rate=0.5)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+
+The counter increments at the top of every run: the first executed
+step computes with step=1.
+"""
+
+from .framework import unique_name
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .layers import tensor as tensor_layers
+
+__all__ = ["exponential_decay", "natural_exp_decay",
+           "inverse_time_decay", "polynomial_decay", "piecewise_decay"]
+
+
+def _helper():
+    return LayerHelper("lr_schedule")
+
+
+def _tmp(helper):
+    return helper.create_tmp_variable("float32", stop_gradient=True)
+
+
+def _op(helper, type, inputs, attrs=None, out=None):
+    out = out if out is not None else _tmp(helper)
+    helper.append_op(type=type, inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs or {})
+    return out
+
+
+def _const(value):
+    return tensor_layers.fill_constant(shape=[1], dtype="float32",
+                                       value=float(value))
+
+
+def _step_counter(helper):
+    """Persistable step count.  Integer (executes as int32 on device):
+    a float32 counter silently stops advancing at 2^24 steps."""
+    counter = helper.create_variable(
+        name=unique_name("lr_sched_step"), persistable=True,
+        dtype="int64", shape=[1])
+    helper.set_variable_initializer(counter, Constant(0))
+    tensor_layers.increment(counter, value=1, in_place=True)
+    return tensor_layers.cast(counter, "float32")
+
+
+def _ratio(helper, step, decay_steps, staircase):
+    # exact division (a float32 reciprocal lands floor/ceil on the
+    # wrong side of exact multiples for many decay_steps values)
+    r = _op(helper, "elementwise_div",
+            {"X": [step], "Y": [_const(decay_steps)]})
+    if staircase:
+        r = _op(helper, "floor", {"X": [r]})
+    return r
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ** (step / decay_steps)."""
+    helper = _helper()
+    step = _step_counter(helper)
+    exponent = _ratio(helper, step, decay_steps, staircase)
+    factor = _op(helper, "elementwise_pow",
+                 {"X": [_const(decay_rate)], "Y": [exponent]})
+    return _op(helper, "scale", {"X": [factor]},
+               {"scale": float(learning_rate)})
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)."""
+    helper = _helper()
+    step = _step_counter(helper)
+    r = _ratio(helper, step, decay_steps, staircase)
+    neg = _op(helper, "scale", {"X": [r]},
+              {"scale": -float(decay_rate)})
+    factor = _op(helper, "exp", {"X": [neg]})
+    return _op(helper, "scale", {"X": [factor]},
+               {"scale": float(learning_rate)})
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)."""
+    helper = _helper()
+    step = _step_counter(helper)
+    r = _ratio(helper, step, decay_steps, staircase)
+    scaled = _op(helper, "scale", {"X": [r]},
+                 {"scale": float(decay_rate)})
+    denom = _op(helper, "elementwise_add",
+                {"X": [scaled], "Y": [_const(1.0)]})
+    return _op(helper, "elementwise_div",
+               {"X": [_const(learning_rate)], "Y": [denom]})
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    """(lr - end) * (1 - min(step, N)/N) ** power + end; with cycle the
+    horizon N stretches to ceil(step/N) * N (reference poly schedule)."""
+    helper = _helper()
+    step = _step_counter(helper)
+    n = _const(decay_steps)
+    if cycle:
+        cycles = _op(helper, "ceil", {"X": [
+            _op(helper, "elementwise_div",
+                {"X": [step], "Y": [_const(decay_steps)]})]})
+        # the very first step has ceil(1/N)=1 cycle; keep at least one
+        cycles = _op(helper, "elementwise_max",
+                     {"X": [cycles], "Y": [_const(1.0)]})
+        n = _op(helper, "elementwise_mul", {"X": [cycles], "Y": [n]})
+    capped = _op(helper, "elementwise_min", {"X": [step], "Y": [n]})
+    frac = _op(helper, "elementwise_sub", {"X": [_const(1.0)],
+               "Y": [_op(helper, "elementwise_div",
+                         {"X": [capped], "Y": [n]})]})
+    poly = _op(helper, "elementwise_pow",
+               {"X": [frac], "Y": [_const(power)]})
+    span = _op(helper, "scale", {"X": [poly]},
+               {"scale": float(learning_rate)
+                - float(end_learning_rate)})
+    return _op(helper, "elementwise_add",
+               {"X": [span], "Y": [_const(end_learning_rate)]})
+
+
+def piecewise_decay(boundaries, values):
+    """Step-function schedule: values[i] while step < boundaries[i],
+    values[-1] after the last boundary."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("need len(values) == len(boundaries) + 1")
+    if list(boundaries) != sorted(boundaries):
+        raise ValueError("boundaries must be ascending, got %r"
+                         % (boundaries,))
+    helper = _helper()
+    step = _step_counter(helper)
+    # sum of indicator * value over the segments
+    lr = _const(0.0)
+    prev_bound = None
+    for i, v in enumerate(values):
+        below = None
+        if i < len(boundaries):
+            below = tensor_layers.cast(
+                _op(helper, "less_than",
+                    {"X": [step], "Y": [_const(boundaries[i])]}),
+                "float32")
+        if prev_bound is None:
+            ind = below if below is not None else _const(1.0)
+        else:
+            at_or_after = _op(helper, "elementwise_sub",
+                              {"X": [_const(1.0)],
+                               "Y": [prev_bound]})
+            ind = at_or_after if below is None else _op(
+                helper, "elementwise_mul",
+                {"X": [at_or_after], "Y": [below]})
+        term = _op(helper, "scale", {"X": [ind]}, {"scale": float(v)})
+        lr = _op(helper, "elementwise_add", {"X": [lr], "Y": [term]})
+        if below is not None:
+            prev_bound = below
+    return lr

@@ -1,0 +1,104 @@
+"""In-program learning-rate schedules (reference:
+parameter/LearningRateScheduler.cpp poly/exp/linear schedules): each
+schedule's per-step LR matches the closed form, and an optimizer
+driven by a schedule Variable actually applies the decayed rate."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import lr_schedules
+
+
+def _run_schedule(build, steps):
+    lr = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out = []
+    for _ in range(steps):
+        v, = exe.run(fluid.default_main_program(), fetch_list=[lr])
+        out.append(float(np.asarray(v).reshape(-1)[0]))
+    return np.asarray(out)
+
+
+def test_exponential_and_natural_and_inverse():
+    lrs = _run_schedule(
+        lambda: lr_schedules.exponential_decay(0.1, 4, 0.5), 8)
+    want = 0.1 * 0.5 ** (np.arange(1, 9) / 4.0)
+    np.testing.assert_allclose(lrs, want, rtol=1e-5)
+
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+
+    for build, ref in [
+        (lambda: lr_schedules.exponential_decay(0.1, 4, 0.5,
+                                                staircase=True),
+         lambda t: 0.1 * 0.5 ** np.floor(t / 4.0)),
+        (lambda: lr_schedules.natural_exp_decay(0.2, 5, 0.7),
+         lambda t: 0.2 * np.exp(-0.7 * t / 5.0)),
+        (lambda: lr_schedules.inverse_time_decay(0.3, 2, 0.5),
+         lambda t: 0.3 / (1 + 0.5 * t / 2.0)),
+    ]:
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        scope_mod.reset_global_scope()
+        lrs = _run_schedule(build, 6)
+        np.testing.assert_allclose(lrs, ref(np.arange(1.0, 7.0)),
+                                   rtol=1e-5)
+
+
+def test_polynomial_decay():
+    lrs = _run_schedule(
+        lambda: lr_schedules.polynomial_decay(
+            1.0, 4, end_learning_rate=0.1, power=2.0), 8)
+    t = np.minimum(np.arange(1.0, 9.0), 4.0)
+    want = (1.0 - 0.1) * (1 - t / 4.0) ** 2 + 0.1
+    np.testing.assert_allclose(lrs, want, rtol=1e-5)
+
+
+def test_polynomial_decay_cycle():
+    lrs = _run_schedule(
+        lambda: lr_schedules.polynomial_decay(
+            1.0, 3, end_learning_rate=0.0, power=1.0, cycle=True), 7)
+    t = np.arange(1.0, 8.0)
+    n = np.maximum(np.ceil(t / 3.0), 1.0) * 3.0
+    want = (1 - t / n)
+    np.testing.assert_allclose(lrs, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    lrs = _run_schedule(
+        lambda: lr_schedules.piecewise_decay([3, 6], [1.0, 0.5, 0.1]),
+        8)
+    want = [1.0, 1.0, 0.5, 0.5, 0.5, 0.1, 0.1, 0.1]
+    np.testing.assert_allclose(lrs, want, rtol=1e-6)
+
+
+def test_schedule_drives_optimizer():
+    """The schedule Variable feeds SGD: the applied step size halves
+    when the schedule does (w -= lr * grad with grad = 1)."""
+    w = fluid.layers.create_parameter  # noqa: F841 (API presence)
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(
+        input=x, size=1, bias_attr=False,
+        param_attr=fluid.ParamAttr(name="w",
+                                   initializer=fluid.initializer
+                                   .Constant(0.0)))
+    loss = fluid.layers.mean(x=pred)     # d loss / d w = mean(x) = 1
+    # steps are 1-based; step < 3 takes the first value
+    lr = lr_schedules.piecewise_decay([3], [0.5, 0.25])
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.core.scope import global_scope
+
+    feed = {"x": np.ones((4, 1), np.float32)}
+    deltas = []
+    prev = 0.0
+    for _ in range(4):
+        exe.run(fluid.default_main_program(), feed=feed,
+                fetch_list=[loss])
+        cur = float(np.asarray(global_scope().get("w")).reshape(-1)[0])
+        deltas.append(round(prev - cur, 6))
+        prev = cur
+    assert deltas == [0.5, 0.5, 0.25, 0.25], deltas
