@@ -22,7 +22,8 @@ from .layer_helper import LayerHelper
 from .layers import tensor as tensor_layers
 
 __all__ = ["exponential_decay", "natural_exp_decay",
-           "inverse_time_decay", "polynomial_decay", "piecewise_decay"]
+           "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+           "v2_schedule"]
 
 
 def _helper():
@@ -133,14 +134,64 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
                {"X": [span], "Y": [_const(end_learning_rate)]})
 
 
+def v2_schedule(name, learning_rate, decay_a=0.0, decay_b=0.0,
+                batch_size=1):
+    """The reference trainer's schedule spellings, by SAMPLES processed
+    (reference: LearningRateScheduler.cpp — poly/exp/discexp/linear,
+    `settings(learning_rate_schedule=..., learning_rate_decay_a=a,
+    learning_rate_decay_b=b)`).  Our counter ticks once per step, so
+    samples = step * batch_size.
+
+      poly:    lr * (1 + a*n) ** (-b)
+      exp:     lr * a ** (n / b)
+      discexp: lr * a ** floor(n / b)
+      linear:  max(lr - a*n, b)
+      constant: lr
+    """
+    if name == "constant":
+        return float(learning_rate)
+    helper = _helper()
+    step = _step_counter(helper)
+    n = _op(helper, "scale", {"X": [step]},
+            {"scale": float(batch_size)})
+    if name == "poly":
+        base = _op(helper, "elementwise_add",
+                   {"X": [_const(1.0)],
+                    "Y": [_op(helper, "scale", {"X": [n]},
+                              {"scale": float(decay_a)})]})
+        factor = _op(helper, "elementwise_pow",
+                     {"X": [base], "Y": [_const(-float(decay_b))]})
+        return _op(helper, "scale", {"X": [factor]},
+                   {"scale": float(learning_rate)})
+    if name in ("exp", "discexp"):
+        if float(decay_b) <= 0:
+            raise ValueError(
+                "%s schedule needs learning_rate_decay_b > 0 (the "
+                "samples-per-decay horizon); got %r" % (name, decay_b))
+        ratio = _ratio(helper, n, decay_b,
+                       staircase=(name == "discexp"))
+        factor = _op(helper, "elementwise_pow",
+                     {"X": [_const(decay_a)], "Y": [ratio]})
+        return _op(helper, "scale", {"X": [factor]},
+                   {"scale": float(learning_rate)})
+    if name == "linear":
+        dropped = _op(helper, "elementwise_sub",
+                      {"X": [_const(learning_rate)],
+                       "Y": [_op(helper, "scale", {"X": [n]},
+                                 {"scale": float(decay_a)})]})
+        return _op(helper, "elementwise_max",
+                   {"X": [dropped], "Y": [_const(decay_b)]})
+    raise ValueError("unknown learning_rate_schedule %r" % name)
+
+
 def piecewise_decay(boundaries, values):
     """Step-function schedule: values[i] while step < boundaries[i],
     values[-1] after the last boundary."""
     if len(values) != len(boundaries) + 1:
         raise ValueError("need len(values) == len(boundaries) + 1")
-    if list(boundaries) != sorted(boundaries):
-        raise ValueError("boundaries must be ascending, got %r"
-                         % (boundaries,))
+    if any(b2 <= b1 for b1, b2 in zip(boundaries, boundaries[1:])):
+        raise ValueError("boundaries must be strictly increasing, "
+                         "got %r" % (boundaries,))
     helper = _helper()
     step = _step_counter(helper)
     # sum of indicator * value over the segments

@@ -71,6 +71,16 @@ def main(argv=None):
         # reference DSL semantics: settings() owns the learning rate,
         # the learning_method object only picks the update rule
         optimizer.learning_rate = cfg.learning_rate
+    schedule = cfg.extra.get("learning_rate_schedule")
+    if schedule and schedule != "constant":
+        # reference LearningRateScheduler spellings (samples-based)
+        import paddle_tpu.fluid as fluid
+
+        optimizer.learning_rate = fluid.lr_schedules.v2_schedule(
+            schedule, optimizer.learning_rate,
+            decay_a=float(cfg.extra.get("learning_rate_decay_a", 0.0)),
+            decay_b=float(cfg.extra.get("learning_rate_decay_b", 0.0)),
+            batch_size=cfg.batch_size)
 
     parameters = v2.parameters.create(cost)
     if args.init_model_path:

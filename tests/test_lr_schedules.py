@@ -102,3 +102,32 @@ def test_schedule_drives_optimizer():
         deltas.append(round(prev - cur, 6))
         prev = cur
     assert deltas == [0.5, 0.5, 0.25, 0.25], deltas
+
+
+def test_v2_schedule_spellings():
+    """Reference LearningRateScheduler formulas, samples-based
+    (samples = step * batch_size)."""
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+
+    B = 4
+    n = np.arange(1.0, 7.0) * B
+    for name, a, b, ref in [
+        ("poly", 0.01, 0.75,
+         lambda n: 0.5 * (1 + 0.01 * n) ** -0.75),
+        ("exp", 0.5, 8.0, lambda n: 0.5 * 0.5 ** (n / 8.0)),
+        ("discexp", 0.5, 8.0,
+         lambda n: 0.5 * 0.5 ** np.floor(n / 8.0)),
+        ("linear", 0.02, 0.3,
+         lambda n: np.maximum(0.5 - 0.02 * n, 0.3)),
+    ]:
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        scope_mod.reset_global_scope()
+        lrs = _run_schedule(
+            lambda: lr_schedules.v2_schedule(name, 0.5, decay_a=a,
+                                             decay_b=b, batch_size=B),
+            6)
+        np.testing.assert_allclose(lrs, ref(n), rtol=1e-5,
+                                   err_msg=name)
+    assert lr_schedules.v2_schedule("constant", 0.25) == 0.25
