@@ -65,6 +65,16 @@ def assign_value(ctx, ins, attrs):
     return {"Out": [jnp.asarray(values)]}
 
 
+@register_op("fill", stop_gradient_op=True)
+def fill(ctx, ins, attrs):
+    """reference: fill_op.cc — materialize attr `data` into a tensor
+    (the run-once / force_cpu knobs are placement details XLA owns)."""
+    shape = tuple(int(s) for s in attrs["shape"])
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    values = np.asarray(attrs["data"], dtype).reshape(shape)
+    return {"Out": [jnp.asarray(values)]}
+
+
 @register_op("cast")
 def cast(ctx, ins, attrs):
     x = _x(ins)

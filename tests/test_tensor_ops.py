@@ -270,3 +270,15 @@ class TestIm2Sequence(OpTest):
         out = x[0].transpose(1, 2, 0).reshape(9, 2)
         self.outputs = {"Out": (out, [[0, 9]])}
         self.check_output()
+
+
+class TestFill(OpTest):
+    op_type = "fill"
+
+    def test(self):
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "dtype": "float32",
+                      "data": [1, 2, 3, 4, 5, 6]}
+        self.outputs = {"Out": np.arange(1.0, 7.0, dtype="float32")
+                        .reshape(2, 3)}
+        self.check_output()
