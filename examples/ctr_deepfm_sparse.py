@@ -18,10 +18,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np
 
-NUM_FEATURES = 10000   # shared feature space (field-offset encoded ids)
-NUM_FIELDS = 16
-BATCH = 256
-STEPS = 60
+NUM_FEATURES = int(os.environ.get("FEATURES", "10000"))
+NUM_FIELDS = int(os.environ.get("FIELDS", "16"))
+BATCH = int(os.environ.get("BATCH", "256"))
+STEPS = int(os.environ.get("STEPS", "60"))
 
 
 def synthetic_ctr_reader(seed=0):

@@ -37,6 +37,8 @@ def test_example_parses(path):
     ("train_image_classification.py", {"PASSES": "1", "BATCH": "16"}),
     ("scale_five_axes.py", {}),
     ("dist_pserver_fit_a_line.py", {}),
+    ("ctr_deepfm_sparse.py", {"FEATURES": "512", "FIELDS": "4",
+                              "BATCH": "64", "STEPS": "15"}),
 ], ids=lambda v: v if isinstance(v, str) else "")
 def test_example_runs(path, env):
     full_env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",
