@@ -1,80 +1,84 @@
-"""Operator overloading on Variable (reference:
-python/paddle/v2/fluid/layers/math_op_patch.py)."""
+"""Python arithmetic sugar on graph ``Variable``s.
+
+Installing dunder methods on :class:`Variable` lets model code write
+``(x - mean) / std`` and have the expression lower to elementwise ops
+appended to the variable's block.  Capability parity with the
+reference's operator patching (python/paddle/v2/fluid/layers/
+math_op_patch.py); the construction here is table-driven — one spec
+tuple consumed at import time, scalar operands lifted by a single
+helper — rather than the reference's per-method closure scaffolding.
+"""
 
 from ..framework import Variable, unique_name
-from ..layer_helper import LayerHelper
 
-__all__ = ["monkey_patch_variable"]
+__all__ = ["install_variable_arithmetic"]
 
 
-def monkey_patch_variable():
-    def unique_tmp_name():
-        return unique_name("tmp")
+def _fresh_out(block, dtype, lod_level=0):
+    return block.create_var(
+        name=unique_name("tmp"), dtype=dtype, lod_level=lod_level)
 
-    def safe_get_dtype(var):
-        return var.dtype
 
-    def create_tensor(block, value, dtype, shape):
-        value = float(value)
-        tmp_name = unique_tmp_name()
-        var = block.create_var(name=tmp_name, shape=shape, dtype=dtype,
-                               stop_gradient=True)
+def _lift_scalar(value, block, dtype):
+    """Materialise a Python number as a 1-element fill_constant output."""
+    out = block.create_var(
+        name=unique_name("tmp"), shape=[1], dtype=dtype, stop_gradient=True)
+    block.append_op(
+        type="fill_constant", outputs={"Out": [out]},
+        attrs={"dtype": dtype, "shape": [1], "value": float(value)})
+    return out
+
+
+def _cast_to(self, dtype):
+    out = _fresh_out(self.block, dtype)
+    self.block.append_op(
+        type="cast", inputs={"X": [self]}, outputs={"Out": [out]},
+        attrs={"in_dtype": self.dtype, "out_dtype": dtype})
+    return out
+
+
+# (dunder, op type, swap operands).  swap is True only for the r-variants
+# of non-commutative ops; commutative r-variants keep the forward order.
+_BINARY_SPECS = (
+    ("__add__", "elementwise_add", False),
+    ("__radd__", "elementwise_add", False),
+    ("__sub__", "elementwise_sub", False),
+    ("__rsub__", "elementwise_sub", True),
+    ("__mul__", "elementwise_mul", False),
+    ("__rmul__", "elementwise_mul", False),
+    ("__div__", "elementwise_div", False),
+    ("__truediv__", "elementwise_div", False),
+    ("__rdiv__", "elementwise_div", True),
+    ("__rtruediv__", "elementwise_div", True),
+    ("__pow__", "elementwise_pow", False),
+    ("__lt__", "less_than", False),
+    ("__le__", "less_equal", False),
+    ("__gt__", "greater_than", False),
+    ("__ge__", "greater_equal", False),
+)
+
+
+def _binary_dunder(op_type, swap):
+    def method(self, other):
+        block, dtype = self.block, self.dtype
+        if not isinstance(other, Variable):
+            other = _lift_scalar(other, block, dtype)
+        x, y = (other, self) if swap else (self, other)
+        out = _fresh_out(block, dtype, lod_level=self.lod_level)
         block.append_op(
-            type="fill_constant", outputs={"Out": [var]},
-            attrs={"dtype": dtype, "shape": shape, "value": value})
-        return var
-
-    def create_scalar(block, value, dtype):
-        return create_tensor(block, value, dtype, shape=[1])
-
-    def astype(self, dtype):
-        block = self.block
-        out = block.create_var(name=unique_tmp_name(), dtype=dtype)
-        block.append_op(type="cast", inputs={"X": [self]},
-                        outputs={"Out": [out]},
-                        attrs={"in_dtype": self.dtype, "out_dtype": dtype})
+            type=op_type, inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]}, attrs={"axis": -1})
         return out
 
-    def _elemwise_method_creator_(method_name, op_type, reverse=False):
-        def __impl__(self, other_var):
-            block = self.block
-            dtype = safe_get_dtype(self)
-            if not isinstance(other_var, Variable):
-                other_var = create_scalar(block, value=other_var,
-                                          dtype=dtype)
-            lhs, rhs = self, other_var
-            if reverse:
-                lhs, rhs = rhs, lhs
-            out = block.create_var(name=unique_tmp_name(), dtype=dtype,
-                                   lod_level=self.lod_level)
-            block.append_op(
-                type=op_type, inputs={"X": [lhs], "Y": [rhs]},
-                outputs={"Out": [out]}, attrs={"axis": -1})
-            return out
-
-        __impl__.__name__ = method_name
-        return __impl__
-
-    for method, op_type, reverse in (
-            ("__add__", "elementwise_add", False),
-            ("__radd__", "elementwise_add", False),
-            ("__sub__", "elementwise_sub", False),
-            ("__rsub__", "elementwise_sub", True),
-            ("__mul__", "elementwise_mul", False),
-            ("__rmul__", "elementwise_mul", False),
-            ("__div__", "elementwise_div", False),
-            ("__truediv__", "elementwise_div", False),
-            ("__rdiv__", "elementwise_div", True),
-            ("__rtruediv__", "elementwise_div", True),
-            ("__pow__", "elementwise_pow", False),
-            ("__lt__", "less_than", False),
-            ("__le__", "less_equal", False),
-            ("__gt__", "greater_than", False),
-            ("__ge__", "greater_equal", False)):
-        setattr(Variable, method,
-                _elemwise_method_creator_(method, op_type, reverse))
-
-    Variable.astype = astype
+    return method
 
 
-monkey_patch_variable()
+def install_variable_arithmetic():
+    for name, op_type, swap in _BINARY_SPECS:
+        method = _binary_dunder(op_type, swap)
+        method.__name__ = name
+        setattr(Variable, name, method)
+    Variable.astype = _cast_to
+
+
+install_variable_arithmetic()
