@@ -1,22 +1,38 @@
-"""Optimizers: build update ops into the program.
+"""Optimizers: declarative update rules compiled into program ops.
 
-TPU-native equivalent of reference optimizers
-(reference: python/paddle/v2/fluid/optimizer.py — Optimizer:28,
-minimize:204, SGD/Momentum/Adagrad/Adam/Adamax/DecayedAdagrad:228-550).
-`minimize` = append_backward + regularization + clipping +
-per-parameter update ops; the whole train step then compiles into one XLA
-executable with donated parameter buffers.
+Capability parity with the reference optimizer layer (reference:
+python/paddle/v2/fluid/optimizer.py — minimize:204, the SGD/Momentum/
+Adagrad/Adam/Adamax/DecayedAdagrad zoo :228-550), with a different
+internal architecture.  The reference extends optimizers by overriding
+a template-method triple (create accumulators / append op / finish
+update); here an optimizer *declares* its update rule as data —
+
+  * ``op_type``        — the per-parameter update op it emits,
+  * ``state_slots``    — per-parameter accumulators (velocity, moments),
+  * ``shared_scalars`` — cross-parameter scalar state (Adam beta powers)
+                         with a per-step decay factor,
+  * ``_hyper_attrs()`` — the op's hyperparameter attrs,
+
+and a single engine materialises the state variables and emits the ops.
+Declaring the rule (rather than open-coding op emission per class) is
+what lets ``fluid.fusion`` re-group the emitted ops into a few stacked
+``fused_update`` kernels: every op of one optimizer provably shares a
+recipe.  `minimize` = append_backward + clipping + regularization +
+this pass; the whole train step then compiles into one XLA executable
+with parameter buffers donated for in-place update.
 """
 
-from collections import defaultdict
+from collections import namedtuple
 
 from . import framework
+from . import fusion
 from .framework import unique_name, Variable
 from .backward import append_backward
 from .initializer import Constant
 from .layer_helper import LayerHelper
 from .regularizer import append_regularization_ops
 from . import clip as clip_mod
+from ..utils import flags
 
 __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
            "Adadelta", "RMSProp", "Ftrl",
@@ -25,120 +41,160 @@ __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
            "AdadeltaOptimizer", "RMSPropOptimizer", "FtrlOptimizer",
            "Optimizer"]
 
+# a per-parameter accumulator: variable named {param}_{name}, wired into
+# the update op at in_key and written back at out_key
+StateSlot = namedtuple("StateSlot", ["name", "in_key", "out_key", "fill"])
+
+# a cross-parameter scalar (e.g. beta1^t): initialised to `init`, read by
+# every update op at in_key, multiplied by step_factor once per step
+SharedScalar = namedtuple("SharedScalar",
+                          ["name", "in_key", "init", "step_factor"])
+
 
 class Optimizer:
-    def __init__(self, learning_rate, regularization=None,
-                 global_step=None):
+    """Engine over a declared update rule; subclasses declare, not code."""
+
+    op_type = None
+    state_slots = ()
+    shared_scalars = ()
+    uses_lr = True  # adadelta's rule derives its step size from state
+
+    def __init__(self, learning_rate, regularization=None, global_step=None):
         if not isinstance(learning_rate, (float, Variable)):
             raise TypeError("learning_rate should be float or Variable")
         self._learning_rate = learning_rate
         self.regularization = regularization
         self._global_step = global_step
-        self._accumulators = defaultdict(dict)
+        # all state caches key by program: one optimizer instance may
+        # minimize losses in several programs, each needing its own vars
+        self._lr_by_program = {}
+        self._slot_vars = {}     # (program, slot name, param name) -> var
+        self._shared_vars = {}   # (program, name) -> var
         self.helper = None
-        self._learning_rate_map = {}
-        # the program minimize() is operating on; set by
-        # create_optimization_pass so accumulators/lr land in the right
+        # the program minimize() operates on, so state lands in the right
         # program even when it is not the default one
         self._target_program = None
 
+    def _hyper_attrs(self):
+        return {}
+
+    @property
+    def type(self):
+        return self.op_type
+
     # -- learning rate ------------------------------------------------------
-    def _create_global_learning_rate(self, program):
-        lr = self._learning_rate_map.get(program)
-        if lr is not None:
+    def _ensure_lr(self, program):
+        if program in self._lr_by_program:
             return
         if isinstance(self._learning_rate, Variable):
-            self._learning_rate_map[program] = self._learning_rate
+            self._lr_by_program[program] = self._learning_rate
             return
-        lr_name = unique_name("learning_rate")
-        lr_var = program.global_block().create_var(
-            name=lr_name, shape=[1], dtype="float32", persistable=True)
+        var = program.global_block().create_var(
+            name=unique_name("learning_rate"), shape=[1], dtype="float32",
+            persistable=True)
         self.helper.set_variable_initializer(
-            lr_var, Constant(float(self._learning_rate)))
-        self._learning_rate_map[program] = lr_var
+            var, Constant(float(self._learning_rate)))
+        self._lr_by_program[program] = var
 
-    def _global_learning_rate(self, program=None):
+    def learning_rate_var(self, program=None):
         if program is None:
-            program = self._target_program or \
-                framework.default_main_program()
-        return self._learning_rate_map.get(program)
+            program = self._target_program or framework.default_main_program()
+        return self._lr_by_program.get(program)
 
-    def _create_param_lr(self, param_and_grad):
-        param = param_and_grad[0]
-        param_lr = getattr(param, "optimize_attr",
-                           {"learning_rate": 1.0}).get("learning_rate", 1.0)
-        base = self._global_learning_rate()
-        if param_lr == 1.0:
+    def _param_lr(self, param):
+        """Per-parameter LR: the global rate scaled by the parameter's
+        optimize_attr learning_rate, if it has one."""
+        base = self.learning_rate_var()
+        scale = getattr(param, "optimize_attr", None) or {}
+        scale = scale.get("learning_rate", 1.0)
+        if scale == 1.0:
             return base
-        helper = self.helper
-        out = helper.create_tmp_variable("float32", stop_gradient=True)
-        helper.append_op(type="scale", inputs={"X": [base]},
-                         outputs={"Out": [out]},
-                         attrs={"scale": float(param_lr)})
+        out = self.helper.create_tmp_variable("float32", stop_gradient=True)
+        self.helper.append_op(type="scale", inputs={"X": [base]},
+                              outputs={"Out": [out]},
+                              attrs={"scale": float(scale)})
         return out
 
-    # -- accumulators -------------------------------------------------------
-    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
-                         shape=None):
-        if param.name in self._accumulators[name]:
-            return self._accumulators[name][param.name]
-        var_name = unique_name("_".join([param.name, name]))
-        block = (self._target_program or
-                 framework.default_main_program()).global_block()
-        var = block.create_var(
-            name=var_name, shape=shape or list(param.shape),
-            dtype=dtype or param.dtype, persistable=True)
-        self.helper.set_variable_initializer(var, Constant(fill_value))
-        self._accumulators[name][param.name] = var
-        return var
+    # -- state --------------------------------------------------------------
+    def _slot_var(self, block, spec, param):
+        key = (block.program, spec.name, param.name)
+        if key not in self._slot_vars:
+            var = block.create_var(
+                name=unique_name("%s_%s" % (param.name, spec.name)),
+                shape=list(param.shape), dtype=param.dtype, persistable=True)
+            self.helper.set_variable_initializer(var, Constant(spec.fill))
+            self._slot_vars[key] = var
+        return self._slot_vars[key]
 
-    def _get_accumulator(self, name, param):
-        return self._accumulators[name][param.name]
+    def _shared_var(self, program, spec):
+        return self._shared_vars[(program, spec.name)]
 
-    # -- hooks for subclasses -----------------------------------------------
-    def _create_accumulators(self, block, parameters):
-        pass
+    def _ensure_shared(self, block, spec):
+        key = (block.program, spec.name)
+        if key in self._shared_vars:
+            return
+        var = block.create_var(name=unique_name(spec.name), shape=[1],
+                               dtype="float32", persistable=True)
+        self.helper.set_variable_initializer(var, Constant(spec.init))
+        self._shared_vars[key] = var
 
-    def _append_optimize_op(self, block, param_and_grad):
-        raise NotImplementedError
+    # -- op emission --------------------------------------------------------
+    def _emit_update(self, block, param, grad):
+        if isinstance(grad, str):
+            grad = block.var(grad)
+        ins = {"Param": [param], "Grad": [grad]}
+        outs = {"ParamOut": [param]}
+        if self.uses_lr:
+            ins["LearningRate"] = [self._param_lr(param)]
+        for spec in self.state_slots:
+            var = self._slot_var(block, spec, param)
+            ins[spec.in_key] = [var]
+            outs[spec.out_key] = [var]
+        for spec in self.shared_scalars:
+            ins[spec.in_key] = [self._shared_var(block.program, spec)]
+        return block.append_op(type=self.op_type, inputs=ins, outputs=outs,
+                               attrs=self._hyper_attrs())
 
-    def _finish_update(self, block):
-        pass
-
-    # -- main entry ---------------------------------------------------------
     def create_optimization_pass(self, parameters_and_grads, loss,
-                                 startup_program=None):
-        """reference: optimizer.py:151."""
+                                 startup_program=None, fuse_updates=None):
+        """Materialise state and emit one update op per parameter
+        (reference entry point: optimizer.py:151), then optionally stack
+        same-recipe ops into fused_update ops."""
         program = loss.block.program
+        block = program.global_block()
         self._target_program = program
         self.helper = LayerHelper(self.__class__.__name__,
                                   main_program=program,
                                   startup_program=startup_program)
-        self._create_accumulators(
-            program.global_block(),
-            [p[0] for p in parameters_and_grads if p[1] is not None])
-        self._create_global_learning_rate(program)
+        self._ensure_lr(program)
+        for spec in self.shared_scalars:
+            self._ensure_shared(block, spec)
 
-        optimize_ops = []
-        for param_and_grad in parameters_and_grads:
-            if param_and_grad[1] is None:
-                continue
-            if getattr(param_and_grad[0], "trainable", True):
-                op = self._append_optimize_op(program.global_block(),
-                                              param_and_grad)
-                optimize_ops.append(op)
+        live = [(p, g) for p, g in parameters_and_grads
+                if g is not None and getattr(p, "trainable", True)]
+        update_ops = [self._emit_update(block, p, g) for p, g in live]
 
-        self._finish_update(program.global_block())
+        # advance shared scalars once per step (beta1^t *= beta1, ...)
+        for spec in self.shared_scalars:
+            if spec.step_factor is not None:
+                var = self._shared_var(program, spec)
+                block.append_op(type="scale", inputs={"X": [var]},
+                                outputs={"Out": [var]},
+                                attrs={"scale": spec.step_factor})
 
         if self._global_step is not None:
             from .layers import tensor as tensor_layers
-
             tensor_layers.increment(self._global_step, value=1.0,
                                     in_place=True)
-        return optimize_ops
+
+        if fuse_updates is None:
+            fuse_updates = flags.get_flag("fuse_optimizer")
+        if fuse_updates:
+            update_ops = fusion.fuse_update_ops(block, update_ops)
+        return update_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, fuse_updates=None):
         """reference: optimizer.py:204."""
         params_grads = append_backward(loss, parameter_list, no_grad_set)
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
@@ -147,293 +203,144 @@ class Optimizer:
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         optimize_ops = self.create_optimization_pass(
-            params_grads, loss, startup_program)
+            params_grads, loss, startup_program, fuse_updates=fuse_updates)
         return optimize_ops, params_grads
 
 
 class SGDOptimizer(Optimizer):
-    def __init__(self, learning_rate, **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "sgd"
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        grad_var = block.var(grad) if isinstance(grad, str) else grad
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad_var],
-                    "LearningRate": [self._create_param_lr(param_and_grad)]},
-            outputs={"ParamOut": [param]})
+    op_type = "sgd"
 
 
 class MomentumOptimizer(Optimizer):
-    _velocity_acc_str = "velocity"
+    op_type = "momentum"
+    state_slots = (StateSlot("velocity", "Velocity", "VelocityOut", 0.0),)
 
     def __init__(self, learning_rate, momentum, use_nesterov=False,
                  **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "momentum"
+        super().__init__(learning_rate, **kwargs)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
-    def _create_accumulators(self, block, parameters):
-        for p in parameters:
-            self._add_accumulator(self._velocity_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        velocity = self._get_accumulator(self._velocity_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad],
-                    "Velocity": [velocity],
-                    "LearningRate": [self._create_param_lr(param_and_grad)]},
-            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
-            attrs={"mu": self._momentum,
-                   "use_nesterov": self._use_nesterov})
+    def _hyper_attrs(self):
+        return {"mu": self._momentum, "use_nesterov": self._use_nesterov}
 
 
 class AdagradOptimizer(Optimizer):
-    _moment_acc_str = "moment"
+    op_type = "adagrad"
+    state_slots = (StateSlot("moment", "Moment", "MomentOut", 0.0),)
 
     def __init__(self, learning_rate, epsilon=1e-6, **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "adagrad"
+        super().__init__(learning_rate, **kwargs)
         self._epsilon = epsilon
 
-    def _create_accumulators(self, block, parameters):
-        for p in parameters:
-            self._add_accumulator(self._moment_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        moment = self._get_accumulator(self._moment_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad], "Moment": [moment],
-                    "LearningRate": [self._create_param_lr(param_and_grad)]},
-            outputs={"ParamOut": [param], "MomentOut": [moment]},
-            attrs={"epsilon": self._epsilon})
+    def _hyper_attrs(self):
+        return {"epsilon": self._epsilon}
 
 
 class AdamOptimizer(Optimizer):
-    _moment1_acc_str = "moment1"
-    _moment2_acc_str = "moment2"
+    op_type = "adam"
+    state_slots = (StateSlot("moment1", "Moment1", "Moment1Out", 0.0),
+                   StateSlot("moment2", "Moment2", "Moment2Out", 0.0))
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "adam"
+        super().__init__(learning_rate, **kwargs)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self.shared_scalars = (
+            SharedScalar("beta1_pow_acc", "Beta1Pow", beta1, beta1),
+            SharedScalar("beta2_pow_acc", "Beta2Pow", beta2, beta2))
 
-    def _create_accumulators(self, block, parameters):
-        main_block = (self._target_program or
-                      framework.default_main_program()).global_block()
-        self._beta1_pow_acc = main_block.create_var(
-            name=unique_name("beta1_pow_acc"), shape=[1], dtype="float32",
-            persistable=True)
-        self.helper.set_variable_initializer(self._beta1_pow_acc,
-                                             Constant(self._beta1))
-        self._beta2_pow_acc = main_block.create_var(
-            name=unique_name("beta2_pow_acc"), shape=[1], dtype="float32",
-            persistable=True)
-        self.helper.set_variable_initializer(self._beta2_pow_acc,
-                                             Constant(self._beta2))
-        for p in parameters:
-            self._add_accumulator(self._moment1_acc_str, p)
-            self._add_accumulator(self._moment2_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        moment1 = self._get_accumulator(self._moment1_acc_str, param)
-        moment2 = self._get_accumulator(self._moment2_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad],
-                    "LearningRate": [self._create_param_lr(param_and_grad)],
-                    "Moment1": [moment1], "Moment2": [moment2],
-                    "Beta1Pow": [self._beta1_pow_acc],
-                    "Beta2Pow": [self._beta2_pow_acc]},
-            outputs={"ParamOut": [param], "Moment1Out": [moment1],
-                     "Moment2Out": [moment2]},
-            attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon})
-
-    def _finish_update(self, block):
-        """Advance beta powers once per step (reference: optimizer.py Adam
-        _finish_update appends scale ops)."""
-        block.append_op(
-            type="scale", inputs={"X": [self._beta1_pow_acc]},
-            outputs={"Out": [self._beta1_pow_acc]},
-            attrs={"scale": self._beta1})
-        block.append_op(
-            type="scale", inputs={"X": [self._beta2_pow_acc]},
-            outputs={"Out": [self._beta2_pow_acc]},
-            attrs={"scale": self._beta2})
+    def _hyper_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
 
 
 class AdamaxOptimizer(Optimizer):
-    _moment_acc_str = "moment"
-    _inf_norm_acc_str = "inf_norm"
+    op_type = "adamax"
+    state_slots = (StateSlot("moment", "Moment", "MomentOut", 0.0),
+                   StateSlot("inf_norm", "InfNorm", "InfNormOut", 0.0))
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "adamax"
+        super().__init__(learning_rate, **kwargs)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self.shared_scalars = (
+            SharedScalar("beta1_pow_acc", "Beta1Pow", beta1, beta1),)
 
-    def _create_accumulators(self, block, parameters):
-        main_block = (self._target_program or
-                      framework.default_main_program()).global_block()
-        self._beta1_pow_acc = main_block.create_var(
-            name=unique_name("beta1_pow_acc"), shape=[1], dtype="float32",
-            persistable=True)
-        self.helper.set_variable_initializer(self._beta1_pow_acc,
-                                             Constant(self._beta1))
-        for p in parameters:
-            self._add_accumulator(self._moment_acc_str, p)
-            self._add_accumulator(self._inf_norm_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        moment = self._get_accumulator(self._moment_acc_str, param)
-        inf_norm = self._get_accumulator(self._inf_norm_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad],
-                    "LearningRate": [self._create_param_lr(param_and_grad)],
-                    "Moment": [moment], "InfNorm": [inf_norm],
-                    "Beta1Pow": [self._beta1_pow_acc]},
-            outputs={"ParamOut": [param], "MomentOut": [moment],
-                     "InfNormOut": [inf_norm]},
-            attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon})
-
-    def _finish_update(self, block):
-        block.append_op(
-            type="scale", inputs={"X": [self._beta1_pow_acc]},
-            outputs={"Out": [self._beta1_pow_acc]},
-            attrs={"scale": self._beta1})
+    def _hyper_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
 
 
 class DecayedAdagradOptimizer(Optimizer):
-    _moment_acc_str = "moment"
+    op_type = "decayed_adagrad"
+    state_slots = (StateSlot("moment", "Moment", "MomentOut", 0.0),)
 
     def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "decayed_adagrad"
+        super().__init__(learning_rate, **kwargs)
         self._decay = decay
         self._epsilon = epsilon
 
-    def _create_accumulators(self, block, parameters):
-        for p in parameters:
-            self._add_accumulator(self._moment_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        moment = self._get_accumulator(self._moment_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad], "Moment": [moment],
-                    "LearningRate": [self._create_param_lr(param_and_grad)]},
-            outputs={"ParamOut": [param], "MomentOut": [moment]},
-            attrs={"decay": self._decay, "epsilon": self._epsilon})
+    def _hyper_attrs(self):
+        return {"decay": self._decay, "epsilon": self._epsilon}
 
 
 class AdadeltaOptimizer(Optimizer):
-    _avg_squared_grad_acc_str = "_avg_squared_grad"
-    _avg_squared_update_acc_str = "_avg_squared_update"
+    op_type = "adadelta"
+    uses_lr = False
+    state_slots = (
+        StateSlot("avg_squared_grad", "AvgSquaredGrad",
+                  "AvgSquaredGradOut", 0.0),
+        StateSlot("avg_squared_update", "AvgSquaredUpdate",
+                  "AvgSquaredUpdateOut", 0.0))
 
     def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "adadelta"
+        super().__init__(learning_rate, **kwargs)
         self._epsilon = epsilon
         self._rho = rho
 
-    def _create_accumulators(self, block, parameters):
-        for p in parameters:
-            self._add_accumulator(self._avg_squared_grad_acc_str, p)
-            self._add_accumulator(self._avg_squared_update_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        asg = self._get_accumulator(self._avg_squared_grad_acc_str, param)
-        asu = self._get_accumulator(self._avg_squared_update_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad],
-                    "AvgSquaredGrad": [asg], "AvgSquaredUpdate": [asu]},
-            outputs={"ParamOut": [param], "AvgSquaredGradOut": [asg],
-                     "AvgSquaredUpdateOut": [asu]},
-            attrs={"epsilon": self._epsilon, "rho": self._rho})
+    def _hyper_attrs(self):
+        return {"epsilon": self._epsilon, "rho": self._rho}
 
 
 class RMSPropOptimizer(Optimizer):
-    _mean_square_acc_str = "mean_square"
-    _moment_acc_str = "moment"
+    op_type = "rmsprop"
+    state_slots = (StateSlot("mean_square", "MeanSquare",
+                             "MeanSquareOut", 0.0),
+                   StateSlot("moment", "Moment", "MomentOut", 0.0))
 
     def __init__(self, learning_rate, decay=0.9, epsilon=1e-6, momentum=0.0,
                  **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "rmsprop"
+        super().__init__(learning_rate, **kwargs)
         self._decay = decay
         self._epsilon = epsilon
         self._momentum = momentum
 
-    def _create_accumulators(self, block, parameters):
-        for p in parameters:
-            self._add_accumulator(self._mean_square_acc_str, p)
-            self._add_accumulator(self._moment_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        ms = self._get_accumulator(self._mean_square_acc_str, param)
-        mom = self._get_accumulator(self._moment_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad], "MeanSquare": [ms],
-                    "Moment": [mom],
-                    "LearningRate": [self._create_param_lr(param_and_grad)]},
-            outputs={"ParamOut": [param], "MeanSquareOut": [ms],
-                     "MomentOut": [mom]},
-            attrs={"decay": self._decay, "epsilon": self._epsilon,
-                   "momentum": self._momentum})
+    def _hyper_attrs(self):
+        return {"decay": self._decay, "epsilon": self._epsilon,
+                "momentum": self._momentum}
 
 
 class FtrlOptimizer(Optimizer):
-    _squared_acc_str = "squared"
-    _linear_acc_str = "linear"
+    op_type = "ftrl"
+    state_slots = (StateSlot("squared", "SquaredAccumulator",
+                             "SquaredAccumOut", 0.0),
+                   StateSlot("linear", "LinearAccumulator",
+                             "LinearAccumOut", 0.0))
 
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
                  **kwargs):
-        Optimizer.__init__(self, learning_rate, **kwargs)
-        self.type = "ftrl"
+        super().__init__(learning_rate, **kwargs)
         self._l1 = l1
         self._l2 = l2
         self._lr_power = lr_power
 
-    def _create_accumulators(self, block, parameters):
-        for p in parameters:
-            self._add_accumulator(self._squared_acc_str, p)
-            self._add_accumulator(self._linear_acc_str, p)
-
-    def _append_optimize_op(self, block, param_and_grad):
-        param, grad = param_and_grad
-        sq = self._get_accumulator(self._squared_acc_str, param)
-        lin = self._get_accumulator(self._linear_acc_str, param)
-        return block.append_op(
-            type=self.type,
-            inputs={"Param": [param], "Grad": [grad],
-                    "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
-                    "LearningRate": [self._create_param_lr(param_and_grad)]},
-            outputs={"ParamOut": [param], "SquaredAccumOut": [sq],
-                     "LinearAccumOut": [lin]},
-            attrs={"l1": self._l1, "l2": self._l2,
-                   "lr_power": self._lr_power})
+    def _hyper_attrs(self):
+        return {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power}
 
 
 SGD = SGDOptimizer

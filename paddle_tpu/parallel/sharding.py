@@ -81,7 +81,10 @@ _ACC_NAME = _re.compile(
 
 _OPTIMIZER_OPS = frozenset([
     "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
-    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad"])
+    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad",
+    # stacked same-recipe updates (fluid/fusion.py) — same slot layout,
+    # so the Param/Grad/LearningRate exclusion below applies unchanged
+    "fused_update"])
 
 # optimizer-op input slots that are NOT accumulator state
 _NON_STATE_SLOTS = frozenset(["Param", "Grad", "LearningRate"])

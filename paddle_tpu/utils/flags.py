@@ -66,6 +66,10 @@ DEFINE_flag("use_debug_nans", False,
 DEFINE_flag("amp_bf16", False,
             "cast MXU op operands (mul/matmul/conv) to bfloat16 with "
             "f32 accumulation (see fluid.amp)")
+DEFINE_flag("fuse_optimizer", True,
+            "stack same-recipe per-parameter update ops into fused_update "
+            "ops (fluid/fusion.py) so the compiled step launches a few "
+            "fused kernels instead of one per parameter")
 DEFINE_flag("amp_bf16_act", True,
             "when amp_bf16 is on, keep activations bfloat16 between ops "
             "instead of casting every MXU output back to f32 — halves "

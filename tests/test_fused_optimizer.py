@@ -1,0 +1,206 @@
+"""Fused (stacked) optimizer updates: bit-parity with unfused ops.
+
+The fusion pass (paddle_tpu/fluid/fusion.py) concatenates flattened
+same-recipe per-parameter updates into one `fused_update` op; because
+every recipe is elementwise per parameter, training must be
+*bit-identical* with fusion on or off.  The reference reaches the same
+end with hand-fused GPU training kernels
+(paddle/math/TrainingAlgorithmOp.cu); here it is an IR rewrite, so we
+can assert parity directly.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import fusion
+
+
+def _build_convnet(optimizer_fn, seed=7):
+    """A small conv classifier with several same-shape and
+    different-shape params, built in its own program pair."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    fluid.framework.reset_unique_name()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 12, 12],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                act="relu")
+        h = fluid.layers.conv2d(input=h, num_filters=4, filter_size=3,
+                                act="relu")
+        h = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=h, label=label))
+        opt = optimizer_fn()
+        ops, _ = opt.minimize(loss)
+    return main, startup, loss, ops
+
+
+def _train(main, startup, loss, steps=4, seed=3):
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid.executor import scope_guard, fetch_var
+
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(seed)
+        feeds = [{"img": rng.randn(8, 1, 12, 12).astype("float32"),
+                  "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+                 for _ in range(steps)]
+        losses = [exe.run(main, feed=f, fetch_list=[loss])[0] for f in feeds]
+        params = {p.name: np.asarray(fetch_var(p.name))
+                  for p in main.global_block().all_parameters()}
+    return losses, params
+
+
+OPTIMIZERS = {
+    "sgd": lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    "momentum": lambda: fluid.optimizer.Momentum(learning_rate=0.05,
+                                                 momentum=0.9),
+    "adam": lambda: fluid.optimizer.Adam(learning_rate=0.01),
+    "adagrad": lambda: fluid.optimizer.Adagrad(learning_rate=0.05),
+    "rmsprop": lambda: fluid.optimizer.RMSProp(learning_rate=0.01),
+    "adadelta": lambda: fluid.optimizer.Adadelta(),
+}
+
+
+# adam's update divides by sqrt(m2)+eps; XLA's CPU backend lowers that
+# through a vectorized rsqrt whose low bit depends on lane position, so
+# concatenation shifts results by <= a few ulp.  Every other recipe is
+# lowered with exactly-rounded elementwise ops and must match bitwise.
+_EXACT = {"sgd", "momentum", "adagrad", "rmsprop", "adadelta"}
+
+
+@pytest.mark.parametrize("name", sorted(OPTIMIZERS))
+def test_bit_parity_fused_vs_unfused(name):
+    make = OPTIMIZERS[name]
+
+    main_f, startup_f, loss_f, ops_f = _build_convnet(make)
+    main_u, startup_u, loss_u, ops_u = _build_convnet(make)
+    fusion.unfuse_update_ops(main_u.global_block())
+
+    fused_types = {op.type for op in ops_f}
+    assert "fused_update" in fused_types, fused_types
+    unfused_types = {op.type for op in main_u.global_block().ops}
+    assert "fused_update" not in unfused_types
+
+    losses_f, params_f = _train(main_f, startup_f, loss_f)
+    losses_u, params_u = _train(main_u, startup_u, loss_u)
+
+    assert params_f.keys() == params_u.keys()
+    if name in _EXACT:
+        for lf, lu in zip(losses_f, losses_u):
+            assert np.array_equal(lf, lu), (name, lf, lu)
+        for pname in params_f:
+            assert np.array_equal(params_f[pname], params_u[pname]), \
+                (name, pname)
+    else:
+        for pname in params_f:
+            np.testing.assert_allclose(params_f[pname], params_u[pname],
+                                       rtol=2e-6, atol=1e-7,
+                                       err_msg="%s/%s" % (name, pname))
+
+
+def test_fusion_groups_by_recipe():
+    """All same-dtype params of one optimizer collapse into one op
+    (6 params here: 2 conv w, 2 conv b, fc w, fc b)."""
+    main, _, _, ops = _build_convnet(
+        lambda: fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9))
+    assert len(ops) == 1 and ops[0].type == "fused_update"
+    assert len(ops[0].desc.input("Param")) == 6
+    # velocity slots stacked, learning rate shared
+    assert "Velocity" in ops[0].attr("stacked_slots")
+    assert "LearningRate" not in ops[0].attr("stacked_slots")
+
+
+def test_unfuse_round_trip():
+    """fuse -> unfuse reproduces the per-parameter ops exactly."""
+    main_a, _, _, _ = _build_convnet(
+        lambda: fluid.optimizer.Adam(learning_rate=0.01))
+    main_b, _, _, _ = _build_convnet(
+        lambda: fluid.optimizer.Adam(learning_rate=0.01))
+
+    block_a = main_a.global_block()
+    fusion.unfuse_update_ops(block_a)
+    block_b = main_b.global_block()
+    fusion.unfuse_update_ops(block_b)
+    a = [od.to_dict() for od in block_a.desc.ops]
+    b = [od.to_dict() for od in block_b.desc.ops]
+    assert a == b
+
+
+def test_two_adam_instances_never_share_a_group():
+    """Two Adam instances have distinct beta-pow vars; blockwide fusion
+    must not stack their [1]-shaped scalars into one group."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    fluid.framework.reset_unique_name()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h1 = fluid.layers.fc(input=x, size=4)
+        h2 = fluid.layers.fc(input=x, size=4)
+        loss1 = fluid.layers.mean(x=h1)
+        loss2 = fluid.layers.mean(x=h2)
+        ops1, _ = fluid.optimizer.Adam(learning_rate=0.01).minimize(
+            loss1, fuse_updates=False)
+        ops2, _ = fluid.optimizer.Adam(learning_rate=0.01).minimize(
+            loss2, fuse_updates=False)
+    block = main.global_block()
+    fused = fusion.fuse_update_ops(block)
+    for op in fused:
+        if op.type != "fused_update":
+            continue
+        # every member of a group reads the same beta-pow vars
+        assert len(set(op.desc.input("Beta1Pow"))) == 1
+        assert "Beta1Pow" not in op.attr("stacked_slots")
+
+
+def test_one_optimizer_two_programs():
+    """An optimizer instance reused across programs creates fresh state
+    vars in each (regression: shared scalars were cached by name only)."""
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    mains = []
+    for _ in range(2):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            loss = fluid.layers.mean(x=fluid.layers.fc(input=x, size=4))
+            opt.minimize(loss)
+        mains.append(main)
+    for main in mains:
+        block = main.global_block()
+        for op in block.ops:
+            if op.type in ("adam", "fused_update", "scale"):
+                for names in op.desc.inputs.values():
+                    for n in names:
+                        assert block.has_var_recursive(n), \
+                            "%s reads %r not in its program" % (op.type, n)
+
+
+def test_fuse_flag_env_override(monkeypatch):
+    from paddle_tpu.utils import flags as flags_mod
+
+    monkeypatch.setenv("FLAGS_fuse_optimizer", "0")
+    flags_mod.parse_flags_from_env(["fuse_optimizer"])
+    try:
+        assert flags_mod.get_flag("fuse_optimizer") is False
+        _, _, _, ops = _build_convnet(
+            lambda: fluid.optimizer.SGD(learning_rate=0.1))
+        assert all(op.type == "sgd" for op in ops) and len(ops) == 6
+    finally:
+        flags_mod.set_flag("fuse_optimizer", True)
+
+
+def test_fused_op_survives_desc_round_trip():
+    """stacked_slots / inner_type attrs serialize through the JSON IR."""
+    from paddle_tpu.core.desc import ProgramDesc
+
+    main, _, _, _ = _build_convnet(
+        lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    d = main.desc.to_dict()
+    back = ProgramDesc.from_dict(d)
+    fused = [od for od in back.block(0).ops if od.type == "fused_update"]
+    assert fused and fused[0].attrs["inner_type"] == "sgd"
