@@ -21,33 +21,57 @@ import jax
 import jax.numpy as jnp
 
 
+def bucket_max_seqlen(lengths):
+    """Static per-sequence length bound, rounded up to the next power
+    of two (>= 8) so retrace count stays logarithmic in sequence
+    length."""
+    m = max([int(x) for x in lengths] or [1])
+    b = 8
+    while b < m:
+        b *= 2
+    return b
+
+
 @jax.tree_util.register_pytree_node_class
 class RaggedTensor:
     """values: [T, ...] flat over all sequences of the last lod level.
     row_splits: list (outer→inner) of int32 offset arrays, each [N_i + 1].
     nvalid: scalar int32, number of valid rows in `values` (rows beyond it
-    are padding introduced by bucketing)."""
+    are padding introduced by bucketing).
+    max_seqlen: optional STATIC python int upper bound on any single
+    sequence's length (bucketed at construction).  Splits are dynamic
+    under jit, so without this hint any [batch, time] densification must
+    assume one sequence could own every row — a worst case that is
+    quadratic in total tokens (the recurrence then scans B·T steps over
+    a [B, B·T, D] pad).  The hint keeps the padded time axis (and the
+    scan length) at the bucketed true maximum."""
 
-    def __init__(self, values, row_splits, nvalid=None):
+    def __init__(self, values, row_splits, nvalid=None, max_seqlen=None):
         self.values = values
         self.row_splits = [jnp.asarray(rs, jnp.int32) for rs in row_splits]
         if nvalid is None:
             nvalid = (self.row_splits[-1][-1] if self.row_splits
                       else jnp.int32(values.shape[0]))
         self.nvalid = jnp.asarray(nvalid, jnp.int32)
+        self.max_seqlen = None if max_seqlen is None else int(max_seqlen)
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
         return ((self.values, self.row_splits, self.nvalid),
-                len(self.row_splits))
+                (len(self.row_splits), self.max_seqlen))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        if isinstance(aux, tuple):
+            _, max_seqlen = aux
+        else:  # old single-int aux (lod_level only)
+            max_seqlen = None
         values, row_splits, nvalid = children
         obj = object.__new__(cls)
         obj.values = values
         obj.row_splits = list(row_splits)
         obj.nvalid = nvalid
+        obj.max_seqlen = max_seqlen
         return obj
 
     # -- structure ----------------------------------------------------------
@@ -95,7 +119,9 @@ class RaggedTensor:
         return rs[1:] - rs[:-1]
 
     def with_values(self, values):
-        return RaggedTensor(values, self.row_splits, self.nvalid)
+        # same splits -> same per-sequence lengths, the hint carries over
+        return RaggedTensor(values, self.row_splits, self.nvalid,
+                            max_seqlen=self.max_seqlen)
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -116,7 +142,8 @@ class RaggedTensor:
             if pad:
                 flat = np.concatenate(
                     [flat, np.zeros((pad,) + flat.shape[1:], flat.dtype)], 0)
-        return RaggedTensor(jnp.asarray(flat), [splits], nvalid=total)
+        return RaggedTensor(jnp.asarray(flat), [splits], nvalid=total,
+                            max_seqlen=bucket_max_seqlen(lengths))
 
     def __repr__(self):
         return "RaggedTensor(values=%s%s, lod_level=%d, nseq=%d)" % (

@@ -13,7 +13,7 @@ compilations stays bounded.
 import numpy as np
 
 from .framework import Variable, default_main_program
-from ..core.ragged import RaggedTensor
+from ..core.ragged import RaggedTensor, bucket_max_seqlen
 from ..core.types import np_dtype
 
 __all__ = ["DataFeeder"]
@@ -86,7 +86,13 @@ class _SlotBatch:
                 [values,
                  np.zeros((pad_rows,) + values.shape[1:], values.dtype)],
                 axis=0)
-        return RaggedTensor(self._to_device(values), splits, nvalid=total)
+        # static bucketed per-sequence length bound at the innermost
+        # level: keeps recurrence densification O(B·maxT) (see
+        # ops/sequence.py _padded_time)
+        inner = np.asarray(splits[-1])
+        max_len = bucket_max_seqlen(inner[1:] - inner[:-1])
+        return RaggedTensor(self._to_device(values), splits, nvalid=total,
+                            max_seqlen=max_len)
 
 
 class DataFeeder:

@@ -46,17 +46,32 @@ def _seg_pos(rt, level=-1):
     return seg, inseq, valid
 
 
+def _padded_time(rt):
+    """Static time extent for densifying `rt`: its bucketed max_seqlen
+    hint when it carries one (feeds built by DataFeeder /
+    from_sequences do), else the total-rows worst case.  The hint is
+    what keeps recurrences O(B·maxT) instead of O(B·(B·maxT)) — a [256
+    seqs × 100 tokens] batch pads to [256, 128, D], not [256, 25600,
+    D]."""
+    T = rt.values.shape[0]
+    if rt.max_seqlen is not None:
+        return min(T, int(rt.max_seqlen))
+    return T
+
+
 def ragged_to_padded(rt, fill=0.0):
-    """[T, ...] ragged -> ([B, T, ...] padded, lengths [B]).  maxT = T
-    (static worst case; callers on fixed-length data see no waste after
-    XLA DCE because positions beyond each length are masked)."""
+    """[T, ...] ragged -> ([B, maxT, ...] padded, lengths [B])."""
     seg, inseq, valid = _seg_pos(rt)
     B = rt.nseq()
-    T = rt.values.shape[0]
+    Tp = _padded_time(rt)
     fill = jnp.asarray(fill).astype(rt.values.dtype)
-    padded = jnp.full((B, T) + rt.values.shape[1:], fill, rt.values.dtype)
+    padded = jnp.full((B, Tp) + rt.values.shape[1:], fill,
+                      rt.values.dtype)
     seg_s = jnp.where(valid, seg, B - 1)
-    in_s = jnp.where(valid, inseq, T - 1)
+    # invalid rows index OUT of range so mode="drop" discards them —
+    # an in-range sentinel could collide with a real token's write and
+    # .at[].set with duplicate indices is nondeterministic
+    in_s = jnp.where(valid, inseq, Tp)
     vals = jnp.where(valid.reshape((-1,) + (1,) * (rt.values.ndim - 1)),
                      rt.values, fill)
     padded = padded.at[seg_s, in_s].set(vals, mode="drop")
@@ -66,10 +81,12 @@ def ragged_to_padded(rt, fill=0.0):
 def padded_to_ragged(padded, rt_like):
     """Inverse of ragged_to_padded using rt_like's splits."""
     seg, inseq, valid = _seg_pos(rt_like)
-    vals = padded[seg, inseq]
+    Tp = padded.shape[1]
+    vals = padded[seg, jnp.clip(inseq, 0, Tp - 1)]
     vals = jnp.where(valid.reshape((-1,) + (1,) * (vals.ndim - 1)), vals,
                      0.0 if jnp.issubdtype(vals.dtype, jnp.floating) else 0)
-    return RaggedTensor(vals, rt_like.row_splits, rt_like.nvalid)
+    return RaggedTensor(vals, rt_like.row_splits, rt_like.nvalid,
+                        max_seqlen=rt_like.max_seqlen)
 
 
 @register_op("sequence_pool")
