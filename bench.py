@@ -34,12 +34,21 @@ import numpy as np
 # Baselines: BASELINE.md (IntelOptimizedPaddle.md CPU img/s tables and
 # benchmark/README.md K40m ms/batch converted to img/s at batch 128).
 _MODELS = {
-    "resnet50": dict(baseline=82.35, gflop=12.3, unit="img/s"),
-    "alexnet": dict(baseline=498.94, gflop=2.1, unit="img/s"),
-    "vgg16": dict(baseline=29.83, gflop=46.5, unit="img/s"),
-    "vgg19": dict(baseline=29.83, gflop=59.0, unit="img/s"),
-    "googlenet": dict(baseline=264.83, gflop=4.8, unit="img/s"),
-    "smallnet": dict(baseline=7039.0, gflop=0.04, unit="img/s"),
+    # infer_baseline: reference MKL-DNN inference img/s at batch 16
+    # (/root/reference/benchmark/IntelOptimizedPaddle.md:68-104); vgg16
+    # has no published row (the reference measured vgg19)
+    "resnet50": dict(baseline=82.35, gflop=12.3, unit="img/s",
+                     infer_baseline=217.69),
+    "alexnet": dict(baseline=498.94, gflop=2.1, unit="img/s",
+                    infer_baseline=850.51),
+    "vgg16": dict(baseline=29.83, gflop=46.5, unit="img/s",
+                  infer_baseline=None),
+    "vgg19": dict(baseline=29.83, gflop=59.0, unit="img/s",
+                  infer_baseline=96.75),
+    "googlenet": dict(baseline=264.83, gflop=4.8, unit="img/s",
+                      infer_baseline=600.94),
+    "smallnet": dict(baseline=7039.0, gflop=0.04, unit="img/s",
+                     infer_baseline=None),
     # strongest published LSTM number: batch 256, hidden 256 on
     # K40m = 170 ms/batch -> 1506 samples/s (BASELINE.md:26);
     # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256.
@@ -167,8 +176,11 @@ def _stale_tpu_record(model, metric, amp_bf16):
         return None
     rec = store.get(_record_key(metric, amp_bf16))
     if rec is None:
-        matches = [r for m, r in store.items()
-                   if m.startswith(model + "_")]
+        # fall back only within the same model AND mode — re-emitting a
+        # train record for an infer request would fake out the infer
+        # capture loop (metric format: <model>_<mode>_...)
+        prefix = "_".join(metric.split("_")[:2]) + "_"
+        matches = [r for m, r in store.items() if m.startswith(prefix)]
         if not matches:
             return None
         rec = max(matches, key=lambda r: r.get("measured_at", 0))
@@ -182,10 +194,21 @@ def main():
     if model not in _MODELS:
         raise SystemExit("BENCH_MODEL must be one of %s"
                          % sorted(_MODELS))
+    # BENCH_MODE=infer times the deploy path: the inference clone of the
+    # model run through FunctionalProgram (the InferenceEngine
+    # equivalent, paddle_tpu/jit.py), batch 16 like the reference's
+    # inference tables
+    mode = os.environ.get("BENCH_MODE", "train")
+    if mode not in ("train", "infer"):
+        raise SystemExit("BENCH_MODE must be train or infer")
+    if mode == "infer" and model == "lstm":
+        raise SystemExit("BENCH_MODE=infer supports the image models")
     spec = _MODELS[model]
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    batch = int(os.environ.get("BENCH_BATCH",
+                               "128" if mode == "train" else "16"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    iters = int(os.environ.get("BENCH_ITERS",
+                               "10" if mode == "train" else "30"))
 
     import jax
 
@@ -207,7 +230,7 @@ def main():
             req_metric = "lstm_train_samples_per_sec_batch%d_hidden%d" \
                 % (batch, int(os.environ.get("BENCH_HIDDEN", "256")))
         else:
-            req_metric = "%s_train_imgs_per_sec_batch%d" % (model, batch)
+            req_metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
         stale = _stale_tpu_record(model, req_metric, amp_requested)
         if stale is not None:
             print("bench: accelerator claim failed; re-emitting last "
@@ -258,14 +281,32 @@ def main():
             "BENCH_IMAGE_SIZE", "32" if model == "smallnet" else "224"))
         class_dim = int(os.environ.get(
             "BENCH_CLASS_DIM", "10" if model == "smallnet" else "1000"))
-        main_prog, startup, _, avg_loss = _build_image_model(
-            model, batch, image_size, class_dim)
-        feed_names = ["image", "label"]
-        feeds_np = _image_feeds(batch, image_size, class_dim)
         # scale the FLOPs model when smoke runs at a tiny image size
         ref_size = 32.0 if model == "smallnet" else 224.0
         gflop_per_sample = spec["gflop"] * (image_size / ref_size) ** 2
-        metric = "%s_train_imgs_per_sec_batch%d" % (model, batch)
+        metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
+        feeds_np = _image_feeds(batch, image_size, class_dim)
+        if mode == "infer":
+            from paddle_tpu import models as _models
+            from __graft_entry__ import _build_model
+
+            model_fn = {
+                "resnet50": _models.resnet50, "alexnet": _models.alexnet,
+                "vgg16": _models.vgg16, "vgg19": _models.vgg19,
+                "googlenet": _models.googlenet,
+                "smallnet": _models.smallnet_mnist_cifar}[model]
+            main_prog, startup, logits, _ = _build_model(
+                model_fn, batch, image_size, class_dim, with_loss=False)
+            main_prog = main_prog.clone(for_test=True)
+            avg_loss = logits
+            feed_names = ["image"]
+            feeds_np = {"image": feeds_np["image"]}
+            # spec gflop is fwd+bwd (x3 rule); inference is forward only
+            gflop_per_sample /= 3
+        else:
+            main_prog, startup, _, avg_loss = _build_image_model(
+                model, batch, image_size, class_dim)
+            feed_names = ["image", "label"]
 
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
@@ -307,11 +348,14 @@ def main():
                        and "BENCH_PEAK_TFLOPS" not in os.environ))
     mfu = (None if mfu_invalid else round(
         samples_per_sec * gflop_per_sample / (peak_tflops * 1e3), 4))
+    baseline = (spec["baseline"] if mode == "train"
+                else spec.get("infer_baseline"))
     record = {
         "metric": metric,
         "value": round(samples_per_sec, 2),
         "unit": spec["unit"],
-        "vs_baseline": round(samples_per_sec / spec["baseline"], 3),
+        "vs_baseline": (None if baseline is None
+                        else round(samples_per_sec / baseline, 3)),
         "step_ms": round(step_ms, 2),
         "mfu": mfu,
         "amp_bf16": amp_bf16,

@@ -77,6 +77,17 @@ while true; do
     bench_one "resnet50-b128-f32" \
       "resnet50_train_imgs_per_sec_batch128|f32" \
       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_AMP=0 || ok=0
+    bench_one "resnet50-b16-infer" \
+      "resnet50_infer_imgs_per_sec_batch16|bf16" \
+      BENCH_MODEL=resnet50 BENCH_MODE=infer || ok=0
+    bench_one "vgg19-b16-infer" "vgg19_infer_imgs_per_sec_batch16|bf16" \
+      BENCH_MODEL=vgg19 BENCH_MODE=infer || ok=0
+    bench_one "googlenet-b16-infer" \
+      "googlenet_infer_imgs_per_sec_batch16|bf16" \
+      BENCH_MODEL=googlenet BENCH_MODE=infer || ok=0
+    bench_one "alexnet-b16-infer" \
+      "alexnet_infer_imgs_per_sec_batch16|bf16" \
+      BENCH_MODEL=alexnet BENCH_MODE=infer || ok=0
     say "profiling ..."
     env PROFILE_STEPS=10 timeout 2400 python scripts/profile_tpu.py \
       >>"$log" 2>&1 && say "profile OK" || say "profile FAILED"
