@@ -28,7 +28,35 @@ __all__ = [
     "sequence_slice", "lod_reset", "edit_distance", "ctc_greedy_decoder",
     "sequence_concat", "beam_search", "beam_search_decode",
     "sequence_reverse", "sequence_unnest", "sequence_renest",
+    "flash_attention",
 ]
+
+
+def flash_attention(queries, keys, values, num_heads=1, causal=False,
+                    sm_scale=None, sequence_parallel_axis="",
+                    block_size=128, name=None):
+    """Fused multi-head attention over dense [batch, seq, dim] tensors.
+
+    Exceeds the reference surface (python/paddle/v2/fluid/nets.py:338
+    materializes the [T,T] probability matrix from composed ops): this
+    lowers to the single `flash_attention` op whose kernel is the
+    pallas online-softmax kernel (kernels/flash_attention.py) — TPU
+    MXU blocks, no T×T in HBM, blockwise-recompute VJP.  With
+    `sequence_parallel_axis` set and the program compiled under a mesh
+    carrying that axis, the op runs ring attention: K/V rotate over ICI
+    neighbors while q/k/v stay sequence-sharded (parallel/ring.py).
+    """
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_tmp_variable(queries.dtype)
+    helper.append_op(
+        type="flash_attention",
+        inputs={"Q": [queries], "K": [keys], "V": [values]},
+        outputs={"Out": [out]},
+        attrs={"num_heads": int(num_heads), "causal": bool(causal),
+               "sm_scale": float(sm_scale or 0.0),
+               "sequence_parallel_axis": sequence_parallel_axis,
+               "block_size": int(block_size)})
+    return out
 
 
 def dynamic_lstm(input, size, param_attr=None, bias_attr=None,

@@ -1,0 +1,85 @@
+"""Transformer built through the Program stack (fluid layers).
+
+The raw-JAX flagship (models/transformer.py) covers scale experiments;
+this is the same GPT-style decoder expressed as a fluid Program, so the
+whole framework surface applies: real optimizers with accumulators,
+regularizers/clipping, LR schedules, checkpointing, the transpiler, and
+`ParallelTrainer` sharding over dp×mp×sp meshes.  Attention is the
+registered `flash_attention` op (ops/attention.py) — pallas kernel on
+TPU, ring attention over ICI when `sp_axis` names a mesh axis — which
+is the in-framework surface the reference lacks (its nets-module
+attention materializes the [T,T] matrix, reference:
+python/paddle/v2/fluid/nets.py:338).
+
+Activation is relu (the 2018 reference op set has no gelu; the raw-JAX
+stack uses gelu where it matters for parity with modern checkpoints).
+"""
+
+import numpy as np
+
+from .. import fluid
+
+__all__ = ["build_transformer_program", "transformer_program_feeds"]
+
+
+def _block(x, n_head, d_model, d_ff, causal, sp_axis):
+    h = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    qkv = fluid.layers.fc(input=h, size=3 * d_model, num_flatten_dims=2)
+    q, k, v = fluid.layers.split(qkv, num_or_sections=3, dim=-1)
+    o = fluid.layers.flash_attention(
+        q, k, v, num_heads=n_head, causal=causal,
+        sequence_parallel_axis=sp_axis)
+    x = x + fluid.layers.fc(input=o, size=d_model, num_flatten_dims=2)
+
+    h = fluid.layers.layer_norm(x, begin_norm_axis=2)
+    h = fluid.layers.fc(input=h, size=d_ff, num_flatten_dims=2,
+                        act="relu")
+    return x + fluid.layers.fc(input=h, size=d_model, num_flatten_dims=2)
+
+
+def build_transformer_program(batch, seq_len, vocab_size, n_layer=2,
+                              n_head=4, d_model=64, d_ff=None,
+                              causal=True, sp_axis=""):
+    """Returns (main, startup, avg_loss, logits).
+
+    Feeds: tokens/positions int64 [batch, seq_len], targets int64
+    [batch, seq_len, 1] (use `transformer_program_feeds`).
+    """
+    if d_ff is None:
+        d_ff = 4 * d_model
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        tokens = fluid.layers.data(
+            name="tokens", shape=[batch, seq_len], dtype="int64",
+            append_batch_size=False)
+        positions = fluid.layers.data(
+            name="positions", shape=[batch, seq_len], dtype="int64",
+            append_batch_size=False)
+        targets = fluid.layers.data(
+            name="targets", shape=[batch, seq_len, 1], dtype="int64",
+            append_batch_size=False)
+
+        x = fluid.layers.embedding(tokens, size=[vocab_size, d_model]) \
+            + fluid.layers.embedding(positions, size=[seq_len, d_model])
+        for _ in range(n_layer):
+            x = _block(x, n_head, d_model, d_ff, causal, sp_axis)
+        x = fluid.layers.layer_norm(x, begin_norm_axis=2)
+        logits = fluid.layers.fc(input=x, size=vocab_size,
+                                 num_flatten_dims=2)
+
+        flat = fluid.layers.reshape(x=logits, shape=[-1, vocab_size])
+        flat_tgt = fluid.layers.reshape(x=targets, shape=[-1, 1])
+        loss = fluid.layers.softmax_with_cross_entropy(flat, flat_tgt)
+        avg_loss = fluid.layers.mean(x=loss)
+    return main, startup, avg_loss, logits
+
+
+def transformer_program_feeds(batch, seq_len, vocab_size, seed=0):
+    rs = np.random.RandomState(seed)
+    tokens = rs.randint(0, vocab_size, size=(batch, seq_len))
+    targets = rs.randint(0, vocab_size, size=(batch, seq_len, 1))
+    positions = np.broadcast_to(np.arange(seq_len), (batch, seq_len))
+    return {"tokens": tokens.astype(np.int64),
+            "positions": np.ascontiguousarray(positions).astype(np.int64),
+            "targets": targets.astype(np.int64)}

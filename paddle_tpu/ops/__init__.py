@@ -21,6 +21,7 @@ from . import conv          # noqa: F401
 from . import norm          # noqa: F401
 from . import sparse        # noqa: F401
 from . import nn            # noqa: F401
+from . import attention     # noqa: F401
 from . import sequence      # noqa: F401
 from . import control_flow  # noqa: F401
 from . import crf           # noqa: F401

@@ -1,0 +1,78 @@
+"""Attention ops: the pallas flash kernel as a registered framework op.
+
+The reference's attention is composed ops that materialize the [T,T]
+probability matrix (reference: python/paddle/v2/fluid/nets.py:338
+scaled_dot_product_attention); registering the fused kernel as a
+first-class op exceeds that surface: programs built with
+`fluid.layers.flash_attention` get the pallas online-softmax kernel
+(kernels/flash_attention.py) on TPU, interpret mode on CPU, and the
+blockwise-recompute VJP through the generic grad machinery (the
+kernel's custom_vjp is what jax.vjp differentiates).
+
+When the op's `sequence_parallel_axis` attr names an axis of the
+ambient device mesh (the mesh `ParallelTrainer` compiles under), the
+kernel runs ring attention instead: q/k/v stay sequence-sharded and
+K/V blocks rotate over ICI neighbors (parallel/ring.py), so fluid-built
+programs scale to long context without leaving the Program stack.
+"""
+
+import jax
+
+from .registry import register_op
+
+
+def _ambient_mesh():
+    """The mesh of the enclosing `with mesh:` scope (empty Mesh if not
+    inside one) — how a program-level op discovers the sp topology
+    without threading a mesh argument through every layer."""
+    from jax._src import mesh as mesh_lib
+
+    return mesh_lib.thread_resources.env.physical_mesh
+
+
+def _split_heads(x, num_heads):
+    b, t, d = x.shape
+    return x.reshape(b, t, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, t, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+
+@register_op("flash_attention")
+def flash_attention_op(ctx, ins, attrs):
+    """Q,K,V: [batch, seq, dim] dense; Out: [batch, seq_q, dim]."""
+    from ..kernels.flash_attention import flash_attention
+    from ..parallel.ring import ring_attention, sp_shard_map
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    num_heads = int(attrs.get("num_heads", 1))
+    causal = bool(attrs.get("causal", False))
+    sm_scale = float(attrs.get("sm_scale", 0.0)) or None
+    sp_axis = attrs.get("sequence_parallel_axis", "")
+
+    for name, t in (("Q", q), ("K", k), ("V", v)):
+        if t.ndim != 3:
+            raise ValueError("flash_attention %s must be 3-D "
+                             "[batch, seq, dim], got %s" % (name, t.shape))
+        if t.shape[-1] % num_heads:
+            raise ValueError("hidden size %d must divide num_heads %d"
+                             % (t.shape[-1], num_heads))
+
+    qh = _split_heads(q, num_heads)
+    kh = _split_heads(k, num_heads)
+    vh = _split_heads(v, num_heads)
+
+    mesh = _ambient_mesh()
+    if sp_axis and not mesh.empty and mesh.shape.get(sp_axis, 1) > 1:
+        fn = sp_shard_map(
+            lambda q, k, v: ring_attention(q, k, v, sp_axis, sm_scale,
+                                           causal),
+            mesh, axis_name=sp_axis)
+        out = fn(qh, kh, vh)
+    else:
+        block = int(attrs.get("block_size", 128))
+        out = flash_attention(qh, kh, vh, sm_scale, causal,
+                              block_q=block, block_k=block)
+    return {"Out": [_merge_heads(out).astype(q.dtype)]}

@@ -22,7 +22,8 @@ __all__ = ["make_parallel_step", "ParallelTrainer"]
 
 def make_parallel_step(program, feed_names, fetch_names, mesh,
                        state_template, dp_axis="dp", mp_axis="mp",
-                       donate_state=True, fp=None, zero_stage=0):
+                       donate_state=True, fp=None, zero_stage=0,
+                       feed_specs=None):
     """Compile a Program block into a sharded step function.
 
     Returns (step, state_shardings) where
@@ -34,6 +35,10 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
     (velocity/moment/... vars) over dp — ZeRO-1: GSPMD turns the
     gradient all-reduce into reduce-scatter + all-gather and each chip
     keeps 1/dp of the optimizer state.
+
+    feed_specs overrides the default dp batch sharding per feed name
+    (e.g. {"tokens": P("dp", "sp")} lays the sequence dim over the sp
+    axis for sequence-parallel programs).
     """
     if fp is None:
         fp = FunctionalProgram(program, feed_names, fetch_names)
@@ -54,10 +59,13 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
         for name, v in state_template.items()
     }
 
+    feed_specs = feed_specs or {}
+
     def step(state, feeds, rng):
         feeds = {
             n: jax.lax.with_sharding_constraint(
-                v, NamedSharding(mesh, batch_spec(v.shape, mesh, dp_axis)))
+                v, NamedSharding(mesh, feed_specs.get(
+                    n, batch_spec(v.shape, mesh, dp_axis))))
             if hasattr(v, "shape") else v
             for n, v in feeds.items()
         }
@@ -86,7 +94,7 @@ class ParallelTrainer:
 
     def __init__(self, main_program, startup_program, feed_names,
                  fetch_names, mesh, dp_axis="dp", mp_axis="mp", seed=0,
-                 zero_stage=0):
+                 zero_stage=0, feed_specs=None):
         self.main_program = main_program
         self.startup_program = startup_program
         self.feed_names = list(feed_names)
@@ -95,6 +103,7 @@ class ParallelTrainer:
         self.dp_axis = dp_axis
         self.mp_axis = mp_axis
         self.zero_stage = zero_stage
+        self.feed_specs = feed_specs
         self._base_rng = jax.random.PRNGKey(seed)
         self._step_count = 0
         self._step_fn = None
@@ -116,7 +125,7 @@ class ParallelTrainer:
         self._step_fn, self._shardings = make_parallel_step(
             self.main_program, self.feed_names, self.fetch_names,
             self.mesh, state, dp_axis=self.dp_axis, mp_axis=self.mp_axis,
-            fp=fp, zero_stage=self.zero_stage)
+            fp=fp, zero_stage=self.zero_stage, feed_specs=self.feed_specs)
         # place state on the mesh
         self.state = {
             n: jax.device_put(np.asarray(v), self._shardings[n])
@@ -128,7 +137,10 @@ class ParallelTrainer:
         rng = jax.random.fold_in(self._base_rng, self._step_count)
         self._step_count += 1
         feeds = {n: jnp_asarray(v) for n, v in feeds.items()}
-        fetches, self.state = self._step_fn(self.state, feeds, rng)
+        # trace under the mesh context so mesh-aware op kernels (ring
+        # flash_attention) see the sp topology
+        with self.mesh:
+            fetches, self.state = self._step_fn(self.state, feeds, rng)
         return fetches
 
     def fetch_state(self, name):
