@@ -25,6 +25,7 @@ from .pipeline import (gpipe_spmd, pipeline_apply, split_microbatches,
 from .moe import switch_moe, moe_shard_map, init_moe_params
 from .program_api import (lower_program_fn, PipelineProgramTrainer,
                           MoEProgramLayer)
+from .optim import PytreeOptimizer
 
 __all__ = [
     "make_mesh", "MeshConfig", "param_spec", "batch_spec", "shard_state",
@@ -33,5 +34,5 @@ __all__ = [
     "gpipe_spmd", "pipeline_apply", "split_microbatches",
     "stack_stage_params", "switch_moe", "moe_shard_map",
     "init_moe_params", "lower_program_fn", "PipelineProgramTrainer",
-    "MoEProgramLayer",
+    "MoEProgramLayer", "PytreeOptimizer",
 ]

@@ -1,0 +1,92 @@
+"""Registered update-op kernels applied to parameter pytrees.
+
+The pipeline and MoE schedules hold their parameters as stacked pytrees
+([stage, ...] / [expert, ...]) streamed by shard_map — there is no
+Program block to append update ops to.  Rather than hand-rolling SGD
+there (or duplicating optimizer math), `PytreeOptimizer` drives the
+SAME declarative update rule a `fluid.optimizer` instance carries —
+its op type, state slots, shared scalars, and hyperparameter attrs
+(fluid/optimizer.py) — through the registered op kernel
+(ops/optimizer_ops.py), leaf by leaf.  One rule, two surfaces: program
+ops for executor-driven training, pytree application for schedule-
+driven training.  Fully jittable; state lives alongside the params so
+the schedules shard it the same way.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import get_op_info
+
+__all__ = ["PytreeOptimizer"]
+
+
+class PytreeOptimizer:
+    """Apply a fluid optimizer's update rule over a params pytree.
+
+        opt = PytreeOptimizer(fluid.optimizer.Momentum(0.1, momentum=0.9))
+        state = opt.init(params)
+        params, state = opt.apply(params, grads, state)   # pure/jittable
+    """
+
+    def __init__(self, fluid_optimizer):
+        self._rule = fluid_optimizer
+        if fluid_optimizer.op_type is None:
+            raise ValueError("optimizer declares no update op")
+        self._kernel = get_op_info(fluid_optimizer.op_type).kernel
+        lr = fluid_optimizer._learning_rate
+        if not isinstance(lr, float):
+            raise ValueError(
+                "PytreeOptimizer needs a float learning rate (schedule "
+                "variables live in programs)")
+        self._lr = lr
+
+    def init(self, params):
+        """State pytree: one zeros-like per (state slot, param leaf),
+        plus the shared scalars at their initial values."""
+        slots = {
+            spec.name: jax.tree_util.tree_map(
+                lambda p: jnp.full(p.shape, spec.fill, p.dtype), params)
+            for spec in self._rule.state_slots
+        }
+        shared = {spec.name: jnp.asarray(spec.init, jnp.float32)
+                  for spec in self._rule.shared_scalars}
+        return {"slots": slots, "shared": shared}
+
+    def apply(self, params, grads, state):
+        """Returns (new_params, new_state)."""
+        rule = self._rule
+        attrs = rule._hyper_attrs()
+        lr = jnp.asarray(self._lr, jnp.float32)
+
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        slot_leaves = {
+            spec.name: treedef.flatten_up_to(state["slots"][spec.name])
+            for spec in rule.state_slots
+        }
+
+        new_p, new_slots = [], {spec.name: [] for spec in rule.state_slots}
+        for i, (p, g) in enumerate(zip(leaves_p, leaves_g)):
+            ins = {"Param": [p], "Grad": [g]}
+            if rule.uses_lr:
+                ins["LearningRate"] = [lr]
+            for spec in rule.state_slots:
+                ins[spec.in_key] = [slot_leaves[spec.name][i]]
+            for spec in rule.shared_scalars:
+                ins[spec.in_key] = [state["shared"][spec.name]]
+            outs = self._kernel(None, ins, attrs)
+            new_p.append(outs["ParamOut"][0])
+            for spec in rule.state_slots:
+                new_slots[spec.name].append(outs[spec.out_key][0])
+
+        new_state = {
+            "slots": {name: jax.tree_util.tree_unflatten(treedef, leaves)
+                      for name, leaves in new_slots.items()},
+            "shared": {spec.name:
+                       state["shared"][spec.name] * spec.step_factor
+                       if spec.step_factor is not None
+                       else state["shared"][spec.name]
+                       for spec in rule.shared_scalars},
+        }
+        return jax.tree_util.tree_unflatten(treedef, new_p), new_state

@@ -85,15 +85,23 @@ class PipelineProgramTrainer:
 
     step(x, target) runs forward through the microbatch schedule,
     backprops through it (the ppermute transpose IS the backward
-    pipeline), and applies SGD to the stacked stage weights.
+    pipeline), and applies `optimizer`'s declared update rule — a
+    fluid.optimizer instance, its registered op kernel driven over the
+    stacked stage weights by PytreeOptimizer — so pipeline training has
+    the same accumulator state (velocity/moments) as executor training.
     """
 
     def __init__(self, build_stage, mesh, n_microbatches, pp_axis="pp",
-                 lr=0.1):
+                 optimizer=None, lr=0.1):
+        from .optim import PytreeOptimizer
+        from ..fluid.optimizer import MomentumOptimizer
+
         self.mesh = mesh
         self.n_microbatches = n_microbatches
         self.pp_axis = pp_axis
-        self.lr = lr
+        if optimizer is None:
+            optimizer = MomentumOptimizer(learning_rate=lr, momentum=0.9)
+        self.optimizer = PytreeOptimizer(optimizer)
         n_stages = mesh.shape[pp_axis]
         fns, states = [], []
         for i in range(n_stages):
@@ -111,6 +119,9 @@ class PipelineProgramTrainer:
                     "%s vs %s" % (keys, sorted(s)))
         self.stage_fn = fns[0]
         self.stacked = stack_stage_params(states)
+        # optimizer state stacks [S, ...] exactly like the params it
+        # tracks, so it shards over pp with them
+        self.opt_state = self.optimizer.init(self.stacked)
         self._step = None
 
     def _loss(self, stacked, x, tgt):
@@ -120,18 +131,17 @@ class PipelineProgramTrainer:
 
     def step(self, x, tgt):
         if self._step is None:
-            lr = self.lr
-
-            def _step(stacked, x, tgt):
+            def _step(stacked, opt_state, x, tgt):
                 loss, grads = jax.value_and_grad(self._loss)(stacked,
                                                              x, tgt)
-                new = jax.tree_util.tree_map(
-                    lambda p, g: p - lr * g, stacked, grads)
-                return loss, new
+                stacked, opt_state = self.optimizer.apply(
+                    stacked, grads, opt_state)
+                return loss, stacked, opt_state
 
             self._step = jax.jit(_step)
-        loss, self.stacked = self._step(self.stacked, jnp.asarray(x),
-                                        jnp.asarray(tgt))
+        loss, self.stacked, self.opt_state = self._step(
+            self.stacked, self.opt_state, jnp.asarray(x),
+            jnp.asarray(tgt))
         return float(loss)
 
 

@@ -182,10 +182,19 @@ def test_pipeline_program_trainer():
         return main, startup, "h", out.name
 
     mesh = _mesh((4, 2), ("pp", "dp"))
-    trainer = PipelineProgramTrainer(build_stage, mesh,
-                                     n_microbatches=4, lr=0.2)
+    trainer = PipelineProgramTrainer(
+        build_stage, mesh, n_microbatches=4,
+        optimizer=fluid.optimizer.Momentum(learning_rate=0.2,
+                                           momentum=0.9))
     rs = np.random.RandomState(0)
     x = rs.randn(16, D).astype(np.float32)
     tgt = np.tanh(x @ (np.eye(D, dtype=np.float32) * 0.5))
     losses = [trainer.step(x, tgt) for _ in range(12)]
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # real framework optimizer state drives the schedule: velocity
+    # accumulators exist per stacked stage param and are non-zero
+    vel = trainer.opt_state["slots"]["velocity"]
+    assert sorted(vel) == sorted(trainer.stacked)
+    for name, v in vel.items():
+        assert v.shape == trainer.stacked[name].shape
+        assert np.abs(np.asarray(v)).max() > 0, name
