@@ -152,6 +152,41 @@ def conditional_block(ctx, ins, attrs):
 _gi("conditional_block").infer_shape = lambda block, od: None
 
 
+@register_op("cond", nondiff_inputs=("Cond",))
+def cond_op(ctx, ins, attrs):
+    """Legacy sample-dependent conditional (reference: cond_op.cc:229):
+    Cond is a bool vector over rows; Out rows come from the true subnet
+    where Cond holds and from the false subnet elsewhere.  The reference
+    gathers each subset into a sub-scope, runs one subnet per subset,
+    and scatters the results back (PrepareDataForSubnet /
+    MergeDataFromSubnet); on TPU data-dependent gathers would force
+    dynamic shapes, so both subnets run over the FULL batch and rows
+    select by mask — branchless, statically shaped, identical row-wise
+    semantics (the reference's subnets are row-wise by construction;
+    like the reference op, no gradient is registered).
+
+    attrs: true_block, false_block, x_names, out_names."""
+    cond_v = jnp.asarray(ins["Cond"][0]).reshape(-1).astype(bool)
+    x_names = list(attrs["x_names"])
+    out_names = list(attrs["out_names"])
+
+    def run(block_attr):
+        env = dict(zip(x_names, ins["Xs"]))
+        _run_block(ctx, block_attr.idx, env)
+        return [env[n] for n in out_names]
+
+    outs_t = run(attrs["true_block"])
+    outs_f = run(attrs["false_block"])
+    outs = []
+    for t, f in zip(outs_t, outs_f):
+        mask = cond_v.reshape((-1,) + (1,) * (jnp.ndim(t) - 1))
+        outs.append(jnp.where(mask, t, f))
+    return {"Outs": outs}
+
+
+_gi("cond").infer_shape = lambda block, od: None
+
+
 # ---------------------------------------------------------------------------
 # recurrent (StaticRNN / DynamicRNN engine)
 # ---------------------------------------------------------------------------

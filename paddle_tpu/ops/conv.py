@@ -99,6 +99,31 @@ def conv2d_transpose(ctx, ins, attrs):
     return {"Output": [amp_result(out, x.dtype)]}
 
 
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx, ins, attrs):
+    """reference: conv_transpose_op.cc:197 (Conv3DTranspose) — the 3-D
+    backward-data convolution, computed like conv2d_transpose: dilate
+    the input by the strides and convolve with the flipped filter."""
+    x = ins["Input"][0]
+    w = ins["Filter"][0]  # [in_c, out_c, kd, kh, kw] (reference layout)
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    paddings = tuple(attrs.get("paddings", [0, 0, 0]))
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    eff = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(3)]
+    xm, wm = mxu_operands(x, jnp.flip(jnp.swapaxes(w, 0, 1), (2, 3, 4)))
+    out = lax.conv_general_dilated(
+        xm, wm,
+        window_strides=(1, 1, 1),
+        padding=[(eff[i] - 1 - paddings[i], eff[i] - 1 - paddings[i])
+                 for i in range(3)],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        **conv_acc_kwargs(xm, wm))
+    _check_spatial(out, "conv3d_transpose", x)
+    return {"Output": [amp_result(out, x.dtype)]}
+
+
 def _pool2d_impl(x, attrs):
     ptype = attrs.get("pooling_type", "max")
     ksize = list(attrs.get("ksize", [2, 2]))
@@ -180,7 +205,9 @@ def max_pool2d_with_index(ctx, ins, attrs):
     x = ins["X"][0]
     out = _pool2d_impl(x, dict(attrs, pooling_type="max"))
     n, c, h, w = x.shape
-    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    # int32 index payload: float32 loses exactness past 2^24 positions
+    # (a 4096x4096 image is already at the boundary)
+    flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
     flat_idx = jnp.broadcast_to(flat_idx, x.shape)
     ksize = list(attrs.get("ksize", [2, 2]))
     strides = list(attrs.get("strides", [1, 1]))
@@ -200,9 +227,49 @@ def max_pool2d_with_index(ctx, ins, attrs):
     strides4 = (1, 1, strides[0], strides[1])
     pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
             (paddings[1], paddings[1]))
-    _, idx = lax.reduce_window((x, flat_idx), (-jnp.inf, 0.0), reducer,
-                               window, strides4, pads)
-    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+    _, idx = lax.reduce_window(
+        (lax.stop_gradient(x), flat_idx), (-jnp.inf, jnp.int32(0)),
+        reducer, window, strides4, pads)
+    return {"Out": [out], "Mask": [idx]}
+
+
+@register_op("max_pool3d_with_index", nondiff_inputs=())
+def max_pool3d_with_index(ctx, ins, attrs):
+    """reference: pool_with_index_op.cc:276 (MaxPool3dWithIndex) — max
+    pool over D/H/W windows plus the flat argmax index per window."""
+    x = ins["X"][0]
+    n, c, d, h, w = x.shape
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", [1, 1, 1]))
+    paddings = list(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [d, h, w]
+        strides = [1, 1, 1]
+        paddings = [0, 0, 0]
+    # int32 indices: a float32 payload loses exactness past 2^24 flat
+    # positions, which 3-D volumes reach easily (256^3 is the boundary)
+    flat_idx = jnp.arange(d * h * w, dtype=jnp.int32).reshape(
+        1, 1, d, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    # differentiable max separately; the (value, index) pair reduction
+    # runs on a stopped gradient — variadic reduce_window cannot carry
+    # mixed tangents through its jvp
+    out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides5, pads)
+    _, idx = lax.reduce_window(
+        (lax.stop_gradient(x), flat_idx), (-jnp.inf, jnp.int32(0)),
+        reducer, window, strides5, pads)
+    _check_spatial(out, "max_pool3d_with_index", x)
+    return {"Out": [out], "Mask": [idx]}
 
 
 @register_op("unpool", nondiff_inputs=("Indices",))

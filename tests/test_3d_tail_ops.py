@@ -1,0 +1,145 @@
+"""The 3-D op tail + legacy cond (the last absent reference ops).
+
+reference: conv_transpose_op.cc:197 (conv3d_transpose),
+pool_with_index_op.cc:276 (max_pool3d_with_index), cond_op.cc:229
+(sample-dependent cond).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.desc import BlockRef
+
+from op_test import OpTest
+
+RS = np.random.RandomState(11)
+
+
+def _conv3d_transpose_ref(x, w, stride, pad):
+    n, cin, d, h, ww = x.shape
+    cin2, cout, kd, kh, kw = w.shape
+    od = (d - 1) * stride[0] - 2 * pad[0] + kd
+    oh = (h - 1) * stride[1] - 2 * pad[1] + kh
+    ow = (ww - 1) * stride[2] - 2 * pad[2] + kw
+    out = np.zeros((n, cout, od + 2 * pad[0], oh + 2 * pad[1],
+                    ow + 2 * pad[2]), np.float32)
+    for b in range(n):
+        for ic in range(cin):
+            for zz in range(d):
+                for yy in range(h):
+                    for xx in range(ww):
+                        patch = x[b, ic, zz, yy, xx] * w[ic]  # [cout,kd,kh,kw]
+                        out[b, :, zz * stride[0]:zz * stride[0] + kd,
+                            yy * stride[1]:yy * stride[1] + kh,
+                            xx * stride[2]:xx * stride[2] + kw] += patch
+    if any(pad):
+        out = out[:, :, pad[0]:pad[0] + od, pad[1]:pad[1] + oh,
+                  pad[2]:pad[2] + ow]
+    return out
+
+
+def _max_pool3d_ref(x, ksize, stride):
+    n, c, d, h, w = x.shape
+    od = (d - ksize[0]) // stride[0] + 1
+    oh = (h - ksize[1]) // stride[1] + 1
+    ow = (w - ksize[2]) // stride[2] + 1
+    out = np.zeros((n, c, od, oh, ow), x.dtype)
+    mask = np.zeros((n, c, od, oh, ow), np.int32)
+    for b in range(n):
+        for cc in range(c):
+            for i in range(od):
+                for j in range(oh):
+                    for k in range(ow):
+                        blk = x[b, cc,
+                                i * stride[0]:i * stride[0] + ksize[0],
+                                j * stride[1]:j * stride[1] + ksize[1],
+                                k * stride[2]:k * stride[2] + ksize[2]]
+                        out[b, cc, i, j, k] = blk.max()
+                        zi, yi, xi = np.unravel_index(blk.argmax(),
+                                                      blk.shape)
+                        mask[b, cc, i, j, k] = (
+                            (i * stride[0] + zi) * h * w
+                            + (j * stride[1] + yi) * w
+                            + k * stride[2] + xi)
+    return out, mask
+
+
+class TestConv3dTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def test(self):
+        x = RS.rand(2, 3, 3, 4, 4).astype("float32")
+        w = RS.rand(3, 4, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 1, 1], "paddings": [0, 1, 0],
+                      "dilations": [1, 1, 1]}
+        self.outputs = {"Output": _conv3d_transpose_ref(
+            x, w, (2, 1, 1), (0, 1, 0))}
+        self.check_output(atol=2e-4)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    op_type = "max_pool3d_with_index"
+
+    def test(self):
+        x = RS.rand(2, 2, 4, 4, 4).astype("float32")
+        out, mask = _max_pool3d_ref(x, (2, 2, 2), (2, 2, 2))
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "paddings": [0, 0, 0]}
+        self.outputs = {"Out": out, "Mask": mask}
+        self.check_output(atol=1e-6)
+        self.check_grad(["X"], "Out", no_grad_set=("Mask",),
+                        max_relative_error=0.02)
+
+    def test_global(self):
+        x = RS.rand(1, 2, 3, 3, 3).astype("float32")
+        out, mask = _max_pool3d_ref(x, (3, 3, 3), (1, 1, 1))
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [1, 1, 1], "strides": [1, 1, 1],
+                      "paddings": [0, 0, 0], "global_pooling": True}
+        self.outputs = {"Out": out, "Mask": mask}
+        self.check_output(atol=1e-6)
+
+
+def test_legacy_cond_rowwise():
+    """cond_op.cc semantics: Out[i] = true_subnet(X)[i] where Cond[i],
+    else false_subnet(X)[i]."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6, 4], dtype="float32",
+                              append_batch_size=False)
+        c = fluid.layers.data(name="c", shape=[6], dtype="int64",
+                              append_batch_size=False)
+
+        tb = main.create_block()
+        t_out = tb.create_var(name="branch_out", dtype="float32")
+        tb.append_op(type="scale", inputs={"X": [x]},
+                     outputs={"Out": [t_out]}, attrs={"scale": 2.0})
+        main.rollback()
+        fb = main.create_block()
+        f_out = fb.create_var(name="branch_out", dtype="float32")
+        fb.append_op(type="scale", inputs={"X": [x]},
+                     outputs={"Out": [f_out]}, attrs={"scale": -1.0})
+        main.rollback()
+
+        out = main.global_block().create_var(name="cond_out",
+                                             dtype="float32")
+        main.global_block().append_op(
+            type="cond", inputs={"Cond": [c], "Xs": [x]},
+            outputs={"Outs": [out]},
+            attrs={"true_block": BlockRef(tb.idx),
+                   "false_block": BlockRef(fb.idx),
+                   "x_names": [x.name], "out_names": ["branch_out"]},
+            infer_shape=False)
+
+    xv = RS.randn(6, 4).astype("float32")
+    cv = np.array([1, 0, 1, 1, 0, 0], np.int64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, = exe.run(main, feed={"x": xv, "c": cv}, fetch_list=[out])
+    want = np.where(cv[:, None] != 0, 2.0 * xv, -xv)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
