@@ -15,14 +15,16 @@ python -m compileall -q paddle_tpu tests examples bench.py \
 echo "[ci] native runtime build ..."
 make -C native
 
-echo "[ci] full test suite ..."
-python -m pytest tests/ -q
+echo "[ci] full test suite (examples run for real, small shapes) ..."
+RUN_EXAMPLES=1 python -m pytest tests/ -q
 
 echo "[ci] driver entry points ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
     python bench.py
-timeout 600 env JAX_PLATFORMS=axon XLA_FLAGS= \
-    python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+# the dryrun is DEFINED on virtual CPU devices; never claim the real
+# chip from CI — a wedged claim would starve the bench watcher
+timeout 900 python -c \
+    "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
 echo "[ci] wheel build ..."
 # --no-build-isolation: build with the env's setuptools (works offline)
