@@ -1,0 +1,136 @@
+"""Micro-benchmark: compiled dense beam search vs the host-op path.
+
+Companion to docs/DESIGN_jit_beam_search.md.  Same scorer both ways:
+
+  * jit-dense — models/decode.beam_search_decode_dense: [batch, beam]
+    state, lax.top_k per step, one compiled scan to max_len (the
+    generation hot path on TPU).
+  * host-op  — the reference-parity LoD bookkeeping (ops/beam.py
+    beam_search kernel) driven one step at a time from Python, the way
+    the fluid while-loop program executes it (reference:
+    beam_search_op.cc registers CPU-only, so every step is a
+    device->host->device round-trip there too).
+
+Prints one JSON line per path.
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from paddle_tpu.core.ragged import RaggedTensor
+from paddle_tpu.models.decode import beam_search_decode_dense
+from paddle_tpu.ops.registry import get_op_info
+
+
+def make_scorer(V, C, seed=0):
+    rs = np.random.RandomState(seed)
+    table = rs.randn(V, C, V).astype(np.float32)
+    jtable = jnp.asarray(table)
+
+    def step_fn(state, tok):
+        t = state["t"]
+        return jtable[tok, jnp.minimum(t, C - 1)], {"t": t + 1}
+
+    return step_fn, table
+
+
+def bench_jit_dense(step_fn, B, K, L, iters=5):
+    state = {"t": jnp.zeros((B,), jnp.int32)}
+    fn = jax.jit(lambda s: beam_search_decode_dense(
+        step_fn, s, bos=1, eos=0, beam_size=K, max_len=L, batch_size=B))
+    seqs, scores = fn(state)          # compile
+    jax.block_until_ready(seqs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        seqs, scores = fn(state)
+    jax.block_until_ready(seqs)
+    dt = (time.perf_counter() - t0) / iters
+    return B * L / dt, seqs
+
+
+def bench_host_op(table, B, K, L, iters=3):
+    """Per-step host bookkeeping: softmax+topk on device-side arrays is
+    simulated with numpy (the op itself is numpy), beam state carried
+    the way the fluid while-loop program carries it."""
+    beam = get_op_info("beam_search").kernel
+    V = table.shape[0]
+    C = table.shape[1]
+
+    def run_once():
+        # beam rows per source; start with one bos row per source
+        toks = np.full((B, 1), 1, np.int64)       # [rows, 1]
+        src_of = np.arange(B)
+        scores = np.zeros((B,), np.float32)
+        n_tokens = 0
+        for t in range(L):
+            rows = toks.shape[0]
+            logits = table[toks[:, 0], min(t, C - 1)]
+            logp = logits - np.log(
+                np.exp(logits - logits.max(1, keepdims=True))
+                .sum(1, keepdims=True)) - logits.max(1, keepdims=True)
+            # per-row candidate top-K (the program's topk before the op)
+            cand = np.argsort(-logp, axis=1)[:, :K]
+            cand_scores = scores[:, None] + np.take_along_axis(
+                logp, cand, axis=1)
+            high = np.searchsorted(src_of, np.arange(B + 1))
+            ids = RaggedTensor(
+                cand.astype(np.int64),
+                [high.astype(np.int64),
+                 np.arange(rows + 1, dtype=np.int64)])
+            sc = RaggedTensor(
+                cand_scores.astype(np.float32),
+                [high.astype(np.int64),
+                 np.arange(rows + 1, dtype=np.int64)])
+            outs = beam(None, {"pre_ids": [toks], "ids": [ids],
+                               "scores": [sc]},
+                        {"beam_size": K, "end_id": 0, "level": 0})
+            sel = outs["selected_ids"][0]
+            sel_ids = np.asarray(sel.values).reshape(-1).astype(np.int64)
+            if sel_ids.size == 0:
+                break
+            splits = np.asarray(sel.row_splits[-1])
+            per_row = splits[1:] - splits[:-1]
+            src_of = np.repeat(src_of, per_row)
+            scores = np.asarray(
+                outs["selected_scores"][0].values).reshape(-1)
+            toks = sel_ids[:, None]
+            n_tokens += sel_ids.size
+        return n_tokens
+
+    run_once()
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(iters):
+        total += run_once()
+    dt = (time.perf_counter() - t0) / iters
+    return B * L / dt
+
+
+def main():
+    B = int(os.environ.get("DECODE_BATCH", "8"))
+    K = int(os.environ.get("DECODE_BEAM", "4"))
+    L = int(os.environ.get("DECODE_LEN", "32"))
+    V = int(os.environ.get("DECODE_VOCAB", "512"))
+    step_fn, table = make_scorer(V, C=8)
+
+    tps, _ = bench_jit_dense(step_fn, B, K, L)
+    print(json.dumps({"path": "jit-dense", "tokens_per_sec": round(tps, 1),
+                      "batch": B, "beam": K, "len": L, "vocab": V,
+                      "platform": jax.devices()[0].platform}))
+    tps_h = bench_host_op(table, B, K, L)
+    print(json.dumps({"path": "host-op", "tokens_per_sec": round(tps_h, 1),
+                      "batch": B, "beam": K, "len": L, "vocab": V,
+                      "platform": "cpu-host"}))
+
+
+if __name__ == "__main__":
+    main()
