@@ -27,17 +27,24 @@ __all__ = [
 ]
 
 
-_name_counters = {}
-
-
-def unique_name(prefix):
-    idx = _name_counters.get(prefix, 0)
-    _name_counters[prefix] = idx + 1
+def unique_name(prefix, program=None):
+    """Next free name for `prefix` in `program` (default: the current
+    main program).  Counters are PER PROGRAM — the own-idiom
+    replacement for a global counter: every fresh Program yields the
+    same deterministic name sequence, so replicated builds (pipeline
+    stages, MoE experts, golden fixtures) agree on parameter names by
+    construction instead of by counter-resetting ceremony."""
+    counters = (program or default_main_program())._name_counters
+    idx = counters.get(prefix, 0)
+    counters[prefix] = idx + 1
     return "%s_%d" % (prefix, idx)
 
 
-def reset_unique_name():
-    _name_counters.clear()
+def reset_unique_name(program=None):
+    """Clear a program's name counters (default: current main program).
+    Rarely needed now that counters are per program; kept for tests
+    that re-build into one program."""
+    (program or default_main_program())._name_counters.clear()
 
 
 def grad_var_name(name):
@@ -330,6 +337,10 @@ class Program:
         self._version = 0
         self._seed_counter = 0
         self._cache_token = next(Program._token_counter)
+        # names scope to the program (see unique_name): a fresh Program
+        # always yields the same deterministic names (fc_0.w_0, ...)
+        # whatever was built before it
+        self._name_counters = {}
 
     def _bump_version(self):
         self._version += 1
@@ -371,6 +382,9 @@ class Program:
         for_test flips `is_test` on ops that have it (dropout, batch_norm)."""
         p = Program()
         p.desc = ProgramDesc.from_dict(copy.deepcopy(self.desc.to_dict()))
+        # building may continue on the clone: carry the name scope so
+        # new layers can't collide with cloned vars
+        p._name_counters = dict(self._name_counters)
         p.blocks = [Block(p, i, desc=bd) for i, bd in enumerate(p.desc.blocks)]
         for b in p.blocks:
             b.sync_with_desc()

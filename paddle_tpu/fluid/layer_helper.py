@@ -44,7 +44,10 @@ class LayerHelper:
     def __init__(self, layer_type, **args):
         self.layer_type = layer_type
         if not args.get("name"):
-            args["name"] = unique_name(layer_type)
+            # name within the program being built (which may not be the
+            # default one when main_program is passed explicitly)
+            args["name"] = unique_name(layer_type,
+                                       program=args.get("main_program"))
         self.kwargs = args  # exposed: a few layers stash extras here
 
     # ---- naming / program targets -----------------------------------
@@ -63,7 +66,8 @@ class LayerHelper:
             default_startup_program()
 
     def _uniq(self, suffix):
-        return unique_name("%s.%s" % (self.name, suffix))
+        return unique_name("%s.%s" % (self.name, suffix),
+                           program=self.kwargs.get("main_program"))
 
     # ---- inputs -----------------------------------------------------
 

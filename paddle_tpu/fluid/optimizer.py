@@ -90,7 +90,8 @@ class Optimizer:
             self._lr_by_program[program] = self._learning_rate
             return
         var = program.global_block().create_var(
-            name=unique_name("learning_rate"), shape=[1], dtype="float32",
+            name=unique_name("learning_rate", program=program),
+            shape=[1], dtype="float32",
             persistable=True)
         self.helper.set_variable_initializer(
             var, Constant(float(self._learning_rate)))
@@ -120,7 +121,8 @@ class Optimizer:
         key = (block.program, spec.name, param.name)
         if key not in self._slot_vars:
             var = block.create_var(
-                name=unique_name("%s_%s" % (param.name, spec.name)),
+                name=unique_name("%s_%s" % (param.name, spec.name),
+                                 program=block.program),
                 shape=list(param.shape), dtype=param.dtype, persistable=True)
             self.helper.set_variable_initializer(var, Constant(spec.fill))
             self._slot_vars[key] = var
@@ -133,7 +135,9 @@ class Optimizer:
         key = (block.program, spec.name)
         if key in self._shared_vars:
             return
-        var = block.create_var(name=unique_name(spec.name), shape=[1],
+        var = block.create_var(name=unique_name(spec.name,
+                                               program=block.program),
+                               shape=[1],
                                dtype="float32", persistable=True)
         self.helper.set_variable_initializer(var, Constant(spec.init))
         self._shared_vars[key] = var

@@ -12,8 +12,6 @@ framework surface is the Program and the distribution is GSPMD +
 shard_map underneath.
 """
 
-import contextlib
-
 import numpy as np
 
 import jax
@@ -25,22 +23,12 @@ from .moe import moe_shard_map
 __all__ = ["lower_program_fn", "PipelineProgramTrainer",
            "MoEProgramLayer"]
 
-
-@contextlib.contextmanager
-def _stable_names():
-    """Run a program build with a fresh unique_name counter so every
-    stage/expert Program gets IDENTICAL parameter names (fc_0.w_0 ...)
-    — names are per-program, so this collides with nothing — then
-    restore the caller's counters."""
-    from ..fluid import framework
-
-    saved = dict(framework._name_counters)
-    framework._name_counters.clear()
-    try:
-        yield
-    finally:
-        framework._name_counters.clear()
-        framework._name_counters.update(saved)
+# Stage/expert builders construct fresh Programs (under program_guard),
+# and name counters are per Program (fluid.framework.unique_name), so
+# every replica build yields identical parameter names (fc_0.w_0 ...)
+# by construction — no counter-resetting ceremony needed here.  The
+# sorted-keys check in PipelineProgramTrainer still guards builders
+# that emit divergent topologies.
 
 
 def lower_program_fn(program, startup, feed_name, fetch_name, seed=None):
@@ -105,8 +93,7 @@ class PipelineProgramTrainer:
         n_stages = mesh.shape[pp_axis]
         fns, states = [], []
         for i in range(n_stages):
-            with _stable_names():
-                program, startup, feed, fetch = build_stage(i)
+            program, startup, feed, fetch = build_stage(i)
             fn, params = lower_program_fn(program, startup, feed, fetch,
                                           seed=i)
             fns.append(fn)
@@ -161,8 +148,7 @@ class MoEProgramLayer:
                  seed=0):
         expert_states, fns = [], []
         for e in range(n_experts):
-            with _stable_names():
-                program, startup, feed, fetch = build_expert()
+            program, startup, feed, fetch = build_expert()
             fn, params = lower_program_fn(program, startup, feed, fetch,
                                           seed=seed + e)
             fns.append(fn)
