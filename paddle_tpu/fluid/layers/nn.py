@@ -34,7 +34,8 @@ __all__ = [
 
 def flash_attention(queries, keys, values, num_heads=1, causal=False,
                     sm_scale=None, sequence_parallel_axis="",
-                    block_size=128, name=None):
+                    sequence_parallel_mode="ring", block_size=128,
+                    name=None):
     """Fused multi-head attention over dense [batch, seq, dim] tensors.
 
     Exceeds the reference surface (python/paddle/v2/fluid/nets.py:338
@@ -43,8 +44,11 @@ def flash_attention(queries, keys, values, num_heads=1, causal=False,
     pallas online-softmax kernel (kernels/flash_attention.py) — TPU
     MXU blocks, no T×T in HBM, blockwise-recompute VJP.  With
     `sequence_parallel_axis` set and the program compiled under a mesh
-    carrying that axis, the op runs ring attention: K/V rotate over ICI
-    neighbors while q/k/v stay sequence-sharded (parallel/ring.py).
+    carrying that axis, the op runs sequence-parallel attention:
+    mode "ring" rotates K/V over ICI neighbors while q/k/v stay
+    sequence-sharded; mode "ulysses" all-to-alls the shard axis from
+    sequence to heads and attends full sequences locally
+    (parallel/ring.py).
     """
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_tmp_variable(queries.dtype)
@@ -55,6 +59,7 @@ def flash_attention(queries, keys, values, num_heads=1, causal=False,
         attrs={"num_heads": int(num_heads), "causal": bool(causal),
                "sm_scale": float(sm_scale or 0.0),
                "sequence_parallel_axis": sequence_parallel_axis,
+               "sequence_parallel_mode": sequence_parallel_mode,
                "block_size": int(block_size)})
     return out
 

@@ -22,13 +22,14 @@ from .. import fluid
 __all__ = ["build_transformer_program", "transformer_program_feeds"]
 
 
-def _block(x, n_head, d_model, d_ff, causal, sp_axis):
+def _block(x, n_head, d_model, d_ff, causal, sp_axis, sp_mode):
     h = fluid.layers.layer_norm(x, begin_norm_axis=2)
     qkv = fluid.layers.fc(input=h, size=3 * d_model, num_flatten_dims=2)
     q, k, v = fluid.layers.split(qkv, num_or_sections=3, dim=-1)
     o = fluid.layers.flash_attention(
         q, k, v, num_heads=n_head, causal=causal,
-        sequence_parallel_axis=sp_axis)
+        sequence_parallel_axis=sp_axis,
+        sequence_parallel_mode=sp_mode)
     x = x + fluid.layers.fc(input=o, size=d_model, num_flatten_dims=2)
 
     h = fluid.layers.layer_norm(x, begin_norm_axis=2)
@@ -39,7 +40,7 @@ def _block(x, n_head, d_model, d_ff, causal, sp_axis):
 
 def build_transformer_program(batch, seq_len, vocab_size, n_layer=2,
                               n_head=4, d_model=64, d_ff=None,
-                              causal=True, sp_axis=""):
+                              causal=True, sp_axis="", sp_mode="ring"):
     """Returns (main, startup, avg_loss, logits).
 
     Feeds: tokens/positions int64 [batch, seq_len], targets int64
@@ -63,7 +64,7 @@ def build_transformer_program(batch, seq_len, vocab_size, n_layer=2,
         x = fluid.layers.embedding(tokens, size=[vocab_size, d_model]) \
             + fluid.layers.embedding(positions, size=[seq_len, d_model])
         for _ in range(n_layer):
-            x = _block(x, n_head, d_model, d_ff, causal, sp_axis)
+            x = _block(x, n_head, d_model, d_ff, causal, sp_axis, sp_mode)
         x = fluid.layers.layer_norm(x, begin_norm_axis=2)
         logits = fluid.layers.fc(input=x, size=vocab_size,
                                  num_flatten_dims=2)

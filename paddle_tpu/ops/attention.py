@@ -44,13 +44,15 @@ def _merge_heads(x):
 def flash_attention_op(ctx, ins, attrs):
     """Q,K,V: [batch, seq, dim] dense; Out: [batch, seq_q, dim]."""
     from ..kernels.flash_attention import flash_attention
-    from ..parallel.ring import ring_attention, sp_shard_map
+    from ..parallel.ring import (ring_attention, ulysses_attention,
+                                 sp_shard_map)
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     num_heads = int(attrs.get("num_heads", 1))
     causal = bool(attrs.get("causal", False))
     sm_scale = float(attrs.get("sm_scale", 0.0)) or None
     sp_axis = attrs.get("sequence_parallel_axis", "")
+    sp_mode = attrs.get("sequence_parallel_mode", "ring")
 
     for name, t in (("Q", q), ("K", k), ("V", v)):
         if t.ndim != 3:
@@ -66,11 +68,19 @@ def flash_attention_op(ctx, ins, attrs):
 
     mesh = _ambient_mesh()
     if sp_axis and not mesh.empty and mesh.shape.get(sp_axis, 1) > 1:
-        fn = sp_shard_map(
-            lambda q, k, v: ring_attention(q, k, v, sp_axis, sm_scale,
-                                           causal),
-            mesh, axis_name=sp_axis)
-        out = fn(qh, kh, vh)
+        if sp_mode == "ring":
+            sp_fn = lambda q, k, v: ring_attention(  # noqa: E731
+                q, k, v, sp_axis, sm_scale, causal)
+        elif sp_mode == "ulysses":
+            # all-to-all trades the sequence shard for a head shard:
+            # local flash attention over full sequences for H/sp heads
+            sp_fn = lambda q, k, v: ulysses_attention(  # noqa: E731
+                q, k, v, sp_axis, sm_scale, causal)
+        else:
+            raise ValueError(
+                "sequence_parallel_mode must be ring or ulysses, got %r"
+                % sp_mode)
+        out = sp_shard_map(sp_fn, mesh, axis_name=sp_axis)(qh, kh, vh)
     else:
         block = int(attrs.get("block_size", 128))
         out = flash_attention(qh, kh, vh, sm_scale, causal,

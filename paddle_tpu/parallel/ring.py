@@ -99,27 +99,26 @@ def ulysses_attention(q, k, v, axis_name="sp", sm_scale=None,
     sequence to heads, attend full sequences locally (flash kernel),
     swap back.  q/k/v local: [B, H, T/sp, D]; H must divide by sp."""
     sp = jax.lax.psum(1, axis_name)
+    if q.shape[1] % sp:
+        raise ValueError(
+            "ulysses attention needs the head count (%d) divisible by "
+            "the sp axis size (%d)" % (q.shape[1], sp))
 
+    # tiled all_to_all does the split/concat in one collective with no
+    # inserted axes: head-group g ships to device g while each device
+    # gathers its group's sequence shards (and the inverse on the way
+    # back).  The untiled reshape choreography used before produced a
+    # mis-transposed cotangent under multi-axis meshes (dp x sp) in
+    # jax's transpose rule; tiled is also simply clearer.
     def seq2head(x):
-        # [B, H, t, D] -> [B, H/sp, T, D]: head-group g ships to device
-        # g; each device gathers its head group's sequence shards
-        B, H, t, D = x.shape
-        x = x.reshape(B, sp, H // sp, t, D)
-        # split_axis=1 removed, gathered source axis inserted at 2:
-        # [B, H/sp, sp, t, D] with axis 2 enumerating sequence shards
-        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                               tiled=False)
-        return x.reshape(B, H // sp, sp * t, D)
+        # [B, H, t, D] -> [B, H/sp, T, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
 
     def head2seq(x):
-        # [B, H/sp, T, D] -> [B, H, t, D] (inverse all-to-all)
-        B, Hs, T, D = x.shape
-        x = x.reshape(B, Hs, sp, T // sp, D)
-        # split_axis=2 removed, source axis (head groups) inserted at 1:
-        # [B, sp, H/sp, t, D]
-        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                               tiled=False)
-        return x.reshape(B, Hs * sp, T // sp, D)
+        # [B, H/sp, T, D] -> [B, H, t, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
 
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
     if use_flash:

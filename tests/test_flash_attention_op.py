@@ -68,7 +68,8 @@ class TestFlashAttentionOp(OpTest):
         self.check_grad(["Q", "K", "V"], "Out", max_relative_error=0.15)
 
 
-def _train_transformer(sp_axis, mesh, feed_specs, steps=3):
+def _train_transformer(sp_axis, mesh, feed_specs, steps=3,
+                       sp_mode="ring"):
     """Build + train the fluid transformer; returns (losses, qkv-weight
     after training)."""
     from paddle_tpu.models.transformer_program import (
@@ -78,7 +79,8 @@ def _train_transformer(sp_axis, mesh, feed_specs, steps=3):
     fluid.framework.reset_unique_name()
     B, T, V = 4, 16, 64
     main, startup, avg_loss, _ = build_transformer_program(
-        B, T, V, n_layer=1, n_head=4, d_model=32, sp_axis=sp_axis)
+        B, T, V, n_layer=1, n_head=4, d_model=32, sp_axis=sp_axis,
+        sp_mode=sp_mode)
     with fluid.program_guard(main, startup):
         fluid.optimizer.Momentum(learning_rate=0.05,
                                  momentum=0.9).minimize(avg_loss)
@@ -119,6 +121,24 @@ def test_fluid_transformer_ring_sp_on_mesh():
     vel = [n for n in trainer.state if "velocity" in n]
     assert vel and any(
         np.abs(np.asarray(trainer.state[n])).max() > 0 for n in vel)
+
+
+def test_fluid_transformer_ulysses_sp_on_mesh():
+    """The all-to-all (Ulysses) sequence-parallel mode computes the
+    same training as the dense path too (heads trade places with the
+    sequence shard; 4 heads / sp=2)."""
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:8]).reshape(4, 2), ("dp", "sp"))
+    specs = {"tokens": P("dp", "sp"), "positions": P("dp", "sp"),
+             "targets": P("dp", "sp", None)}
+
+    uly_losses, uly_w, _ = _train_transformer("sp", mesh, specs,
+                                              sp_mode="ulysses")
+    flat_losses, flat_w, _ = _train_transformer("", mesh, specs)
+
+    assert all(np.isfinite(uly_losses)), uly_losses
+    np.testing.assert_allclose(uly_losses, flat_losses, rtol=2e-5)
+    np.testing.assert_allclose(uly_w, flat_w, rtol=2e-4, atol=2e-6)
 
 
 def test_flash_attention_op_in_program_grads_vs_reference():
