@@ -189,6 +189,14 @@ def _stale_tpu_record(model, metric, amp_bf16):
     return rec
 
 
+def _tagged(metric):
+    """BENCH_TAG distinguishes variant runs of one config in the
+    persisted store and the emitted metric (e.g. the
+    FLAGS_fuse_optimizer=0 A/B: ...batch128+nofuse)."""
+    tag = os.environ.get("BENCH_TAG", "")
+    return "%s+%s" % (metric, tag) if tag else metric
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model not in _MODELS:
@@ -231,6 +239,7 @@ def main():
                 % (batch, int(os.environ.get("BENCH_HIDDEN", "256")))
         else:
             req_metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
+        req_metric = _tagged(req_metric)
         stale = _stale_tpu_record(model, req_metric, amp_requested)
         if stale is not None:
             print("bench: accelerator claim failed; re-emitting last "
@@ -350,6 +359,7 @@ def main():
         samples_per_sec * gflop_per_sample / (peak_tflops * 1e3), 4))
     baseline = (spec["baseline"] if mode == "train"
                 else spec.get("infer_baseline"))
+    metric = _tagged(metric)
     record = {
         "metric": metric,
         "value": round(samples_per_sec, 2),

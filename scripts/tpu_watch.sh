@@ -77,6 +77,12 @@ while true; do
     bench_one "resnet50-b128-f32" \
       "resnet50_train_imgs_per_sec_batch128|f32" \
       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_AMP=0 || ok=0
+    # A/B the stacked optimizer updates (docs/PERF.md round-5 #1):
+    # unfused run persists under the same metric via BENCH_TAG
+    bench_one "resnet50-b128-nofuse" \
+      "resnet50_train_imgs_per_sec_batch128+nofuse|bf16" \
+      BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_TAG=nofuse \
+      FLAGS_fuse_optimizer=0 || ok=0
     bench_one "resnet50-b16-infer" \
       "resnet50_infer_imgs_per_sec_batch16|bf16" \
       BENCH_MODEL=resnet50 BENCH_MODE=infer || ok=0
