@@ -24,6 +24,8 @@ from . import io
 from . import checkpoint
 from . import evaluator
 from . import lr_schedules
+from . import fast_decode
+from .fast_decode import ProgramDecoder
 from . import amp
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize

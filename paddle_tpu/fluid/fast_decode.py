@@ -1,0 +1,125 @@
+"""Compiled generation over a single-step fluid Program.
+
+Bridges the Program stack to the dense jitted decoders
+(models/decode.py): a user expresses ONE decode step as an ordinary
+inference Program — token in, logits out, recurrent state threaded
+through named feed/fetch pairs — and `ProgramDecoder` runs the whole
+generation loop as one XLA executable (lax.scan + top_k), trained
+weights closed over from the scope.
+
+This is the deploy-path answer to the reference's host-side generation
+(RecurrentGradientMachine::beamSearch, beam_search_op.cc — both
+per-step host bookkeeping): same program-building workflow, ~15× the
+decode throughput before counting the per-step device↔host hops the
+host path would add on TPU (docs/DESIGN_jit_beam_search.md).  The LoD
+beam ops remain for program parity.
+
+Usage:
+    decoder = ProgramDecoder(step_prog, token_name="tok",
+                             logits_name=logits.name,
+                             state_pairs=[("h_in", h_out.name)])
+    toks, lengths = decoder.greedy(bos=1, eos=0, max_len=32,
+                                   init_state={"h_in": h0})
+    seqs, scores = decoder.beam(beam_size=4, bos=1, eos=0, max_len=32,
+                                init_state={"h_in": h0})
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..jit import FunctionalProgram, state_from_scope
+from ..models.decode import greedy_decode, beam_search_decode_dense
+
+__all__ = ["ProgramDecoder"]
+
+
+class ProgramDecoder:
+    """Compiled greedy/beam generation from a single-step Program.
+
+    The step program's contract: it reads a token feed (int tensor
+    [batch]), any number of state feeds ([batch, ...]), and fetches
+    logits ([batch, vocab]) plus one new-state fetch per state feed
+    (`state_pairs` lists (feed_name, fetch_var_name) in order).
+    Parameters and other persistables come from `scope` (default: the
+    global scope the program was trained in).
+    """
+
+    def __init__(self, program, token_name, logits_name, state_pairs=(),
+                 scope=None):
+        self.token_name = token_name
+        self.state_pairs = list(state_pairs)
+        feed_names = [token_name] + [f for f, _ in self.state_pairs]
+        fetch_names = [logits_name] + [o for _, o in self.state_pairs]
+        self._fp = FunctionalProgram(program, feed_names, fetch_names)
+        self._params = {n: jnp.asarray(np.asarray(v)) for n, v in
+                        state_from_scope(self._fp, scope).items()}
+        # one compiled executable per decode config (weights are a
+        # runtime argument, so a serving loop pays trace+compile once)
+        self._compiled = {}
+
+    def _step_fn(self, params):
+        fp = self._fp
+        token = self.token_name
+        pairs = self.state_pairs
+
+        def step(state, tok):
+            feeds = {token: tok}
+            feeds.update({f: state[f] for f, _ in pairs})
+            (logits, *new_states), _ = fp(params, feeds)
+            return logits, {f: ns for (f, _), ns in zip(pairs,
+                                                        new_states)}
+
+        return step
+
+    def _prep(self, init_state, batch_size):
+        state = dict(init_state or {})
+        missing = [f for f, _ in self.state_pairs if f not in state]
+        if missing:
+            raise ValueError("init_state missing %s" % missing)
+        known = {f for f, _ in self.state_pairs}
+        extra = sorted(set(state) - known)
+        if extra:
+            raise ValueError(
+                "init_state has keys %s that are not in state_pairs %s"
+                % (extra, sorted(known)))
+        state = {f: jnp.asarray(np.asarray(v)) for f, v in state.items()}
+        if batch_size is None:
+            if not state:
+                raise ValueError(
+                    "batch_size is required when the step program has "
+                    "no state feeds")
+            batch_size = next(iter(state.values())).shape[0]
+        return state, batch_size
+
+    def _jitted(self, key, builder):
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(builder())
+        return self._compiled[key]
+
+    def greedy(self, bos, eos, max_len, batch_size=None, init_state=None):
+        """Returns (tokens [batch, max_len], lengths [batch])."""
+        state, batch_size = self._prep(init_state, batch_size)
+        fn = self._jitted(
+            ("greedy", bos, eos, max_len, batch_size),
+            lambda: lambda params, s: greedy_decode(
+                self._step_fn(params), s, bos=bos, eos=eos,
+                max_len=max_len, batch_size=batch_size))
+        toks, lengths = fn(self._params, state)
+        return np.asarray(toks), np.asarray(lengths)
+
+    def beam(self, beam_size, bos, eos, max_len, batch_size=None,
+             init_state=None, length_penalty=0.0):
+        """Returns (sequences [batch, beam, max_len], scores
+        [batch, beam]), best first."""
+        state, batch_size = self._prep(init_state, batch_size)
+        fn = self._jitted(
+            ("beam", beam_size, bos, eos, max_len, batch_size,
+             length_penalty),
+            lambda: lambda params, s: beam_search_decode_dense(
+                self._step_fn(params), s, bos=bos, eos=eos,
+                beam_size=beam_size, max_len=max_len,
+                batch_size=batch_size, length_penalty=length_penalty))
+        seqs, scores = fn(self._params, state)
+        return np.asarray(seqs), np.asarray(scores)
