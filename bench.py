@@ -54,6 +54,11 @@ _MODELS = {
     # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256.
     # gflop computed per-run from seq_len/hidden, not a constant
     "lstm": dict(baseline=1506.0, gflop=None, unit="samples/s"),
+    # no reference counterpart (the 2018 snapshot has no transformer):
+    # exercises the pallas flash-attention op through the Program
+    # stack; vs_baseline is null by design.  gflop per token computed
+    # per-run from the config.
+    "transformer": dict(baseline=None, gflop=None, unit="tokens/s"),
 }
 
 # MFU denominator: TPU v5e peak (matches the chip the driver benches
@@ -209,11 +214,12 @@ def main():
     mode = os.environ.get("BENCH_MODE", "train")
     if mode not in ("train", "infer"):
         raise SystemExit("BENCH_MODE must be train or infer")
-    if mode == "infer" and model == "lstm":
+    if mode == "infer" and model in ("lstm", "transformer"):
         raise SystemExit("BENCH_MODE=infer supports the image models")
     spec = _MODELS[model]
-    batch = int(os.environ.get("BENCH_BATCH",
-                               "128" if mode == "train" else "16"))
+    default_batch = ("16" if mode == "infer"
+                     else "16" if model == "transformer" else "128")
+    batch = int(os.environ.get("BENCH_BATCH", default_batch))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS",
                                "10" if mode == "train" else "30"))
@@ -237,6 +243,11 @@ def main():
         if model == "lstm":
             req_metric = "lstm_train_samples_per_sec_batch%d_hidden%d" \
                 % (batch, int(os.environ.get("BENCH_HIDDEN", "256")))
+        elif model == "transformer":
+            req_metric = "transformer_train_tokens_per_sec_batch%d_" \
+                "seq%d_d%d" % (batch,
+                               int(os.environ.get("BENCH_SEQ_LEN", "512")),
+                               int(os.environ.get("BENCH_D_MODEL", "512")))
         else:
             req_metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
         req_metric = _tagged(req_metric)
@@ -258,6 +269,10 @@ def main():
         os.environ.setdefault("BENCH_IMAGE_SIZE",
                               "32" if model == "smallnet" else "64")
         os.environ.setdefault("BENCH_SEQ_LEN", "16")
+        os.environ.setdefault("BENCH_D_MODEL", "64")
+        os.environ.setdefault("BENCH_N_LAYER", "2")
+        os.environ.setdefault("BENCH_N_HEAD", "4")
+        os.environ.setdefault("BENCH_VOCAB", "256")
         print("bench: accelerator claim failed; CPU fallback at reduced "
               "shapes", file=sys.stderr, flush=True)
 
@@ -270,6 +285,7 @@ def main():
     if amp_bf16:
         fluid.amp.enable_bf16()
 
+    samples_per_step = batch
     if model == "lstm":
         seq_len = int(os.environ.get("BENCH_SEQ_LEN", "100"))
         hidden = int(os.environ.get("BENCH_HIDDEN", "256"))
@@ -285,6 +301,33 @@ def main():
         # x2 MACs, x3 fwd+bwd
         gflop_per_sample = 3 * 8 * seq_len * hidden * \
             (128 + 7 * hidden) / 1e9
+    elif model == "transformer":
+        from paddle_tpu.models.transformer_program import (
+            build_transformer_program, transformer_program_feeds)
+
+        seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
+        d_model = int(os.environ.get("BENCH_D_MODEL", "512"))
+        n_layer = int(os.environ.get("BENCH_N_LAYER", "6"))
+        n_head = int(os.environ.get("BENCH_N_HEAD", "8"))
+        vocab = int(os.environ.get("BENCH_VOCAB", "8192"))
+        main_prog, startup, avg_loss, _ = build_transformer_program(
+            batch, seq_len, vocab, n_layer=n_layer, n_head=n_head,
+            d_model=d_model)
+        with fluid.program_guard(main_prog, startup):
+            fluid.optimizer.MomentumOptimizer(
+                learning_rate=0.01, momentum=0.9).minimize(avg_loss)
+        feed_names = ["tokens", "positions", "targets"]
+        feeds_np = transformer_program_feeds(batch, seq_len, vocab)
+        metric = "transformer_train_tokens_per_sec_batch%d_seq%d_d%d" \
+            % (batch, seq_len, d_model)
+        # per token, fwd+bwd (x3): ~12*L*d^2 matmul MACs x2, the causal
+        # attention score+context matmuls (T/2 attended keys on average
+        # -> T*d MACs x2 per layer), and the vocab projection (d*V MACs
+        # x2)
+        gflop_per_sample = 3 * (24 * n_layer * d_model ** 2
+                                + 2 * n_layer * seq_len * d_model
+                                + 2 * d_model * vocab) / 1e9
+        samples_per_step = batch * seq_len
     else:
         image_size = int(os.environ.get(
             "BENCH_IMAGE_SIZE", "32" if model == "smallnet" else "224"))
@@ -345,7 +388,7 @@ def main():
     jax.block_until_ready(fetches)
     dt = time.perf_counter() - t0
 
-    samples_per_sec = batch * iters / dt
+    samples_per_sec = samples_per_step * iters / dt
     step_ms = dt / iters * 1e3
     peak_tflops = float(os.environ.get(
         "BENCH_PEAK_TFLOPS",

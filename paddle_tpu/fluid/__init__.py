@@ -24,8 +24,6 @@ from . import io
 from . import checkpoint
 from . import evaluator
 from . import lr_schedules
-from . import fast_decode
-from .fast_decode import ProgramDecoder
 from . import amp
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize
@@ -35,6 +33,11 @@ from .param_attr import ParamAttr
 from ..core.scope import Scope
 from ..core.ragged import RaggedTensor, SelectedRows
 from ..core import ragged as core  # minimal `core`-ish namespace
+
+# last: fast_decode pulls in paddle_tpu.models, whose modules import
+# this (by-then fully initialised) package back
+from . import fast_decode
+from .fast_decode import ProgramDecoder
 
 __all__ = [
     "framework", "layers", "optimizer", "initializer", "regularizer",

@@ -55,6 +55,12 @@ class ProgramDecoder:
         self._fp = FunctionalProgram(program, feed_names, fetch_names)
         self._params = {n: jnp.asarray(np.asarray(v)) for n, v in
                         state_from_scope(self._fp, scope).items()}
+        missing = sorted(set(self._fp.state_in_names) - set(self._params))
+        if missing:
+            raise ValueError(
+                "scope has no values for %s — run the startup program "
+                "(and training) in this scope before building the "
+                "decoder" % missing)
         # one compiled executable per decode config (weights are a
         # runtime argument, so a serving loop pays trace+compile once)
         self._compiled = {}

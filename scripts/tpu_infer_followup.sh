@@ -44,6 +44,9 @@ run_one "resnet50-b128-nofuse" \
   "resnet50_train_imgs_per_sec_batch128+nofuse|bf16" \
   BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_TAG=nofuse \
   FLAGS_fuse_optimizer=0 || ok=0
+run_one "transformer-b16-seq512" \
+  "transformer_train_tokens_per_sec_batch16_seq512_d512|bf16" \
+  BENCH_MODEL=transformer || ok=0
 run_one "resnet50-b16-infer" "resnet50_infer_imgs_per_sec_batch16|bf16" \
   BENCH_MODEL=resnet50 BENCH_MODE=infer || ok=0
 run_one "vgg19-b16-infer" "vgg19_infer_imgs_per_sec_batch16|bf16" \

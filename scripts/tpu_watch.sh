@@ -83,6 +83,9 @@ while true; do
       "resnet50_train_imgs_per_sec_batch128+nofuse|bf16" \
       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_TAG=nofuse \
       FLAGS_fuse_optimizer=0 || ok=0
+    bench_one "transformer-b16-seq512" \
+      "transformer_train_tokens_per_sec_batch16_seq512_d512|bf16" \
+      BENCH_MODEL=transformer || ok=0
     bench_one "resnet50-b16-infer" \
       "resnet50_infer_imgs_per_sec_batch16|bf16" \
       BENCH_MODEL=resnet50 BENCH_MODE=infer || ok=0
