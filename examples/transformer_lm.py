@@ -1,0 +1,118 @@
+"""Train a small transformer LM on the Program stack, then generate
+with the compiled decoders.
+
+    python examples/transformer_lm.py
+    SEQ_LEN=128 D_MODEL=256 N_LAYER=4 python examples/transformer_lm.py
+
+The model is fluid-built (models/transformer_program.py): attention is
+the `flash_attention` op — the pallas online-softmax kernel on TPU,
+interpret mode on CPU — and training runs real Momentum ops (stacked
+fused updates).  Generation reuses the trained weights through
+`fluid.ProgramDecoder`: one decode step expressed as a Program, the
+whole loop compiled (docs/DESIGN_jit_beam_search.md).
+
+Data is a synthetic integer-sequence "language" with a repeating
+structure the model can learn quickly.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from anywhere in the checkout
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models.transformer_program import (
+    build_transformer_program, build_transformer_step_program)
+
+
+def synthetic_batch(rs, batch, seq_len, vocab):
+    """Next-token data over arithmetic sequences mod vocab (learnable
+    in a few steps)."""
+    start = rs.randint(2, vocab, size=(batch, 1))
+    step = rs.randint(1, 5, size=(batch, 1))
+    seq = (start + step * np.arange(seq_len + 1)) % vocab
+    tokens = seq[:, :-1].astype(np.int64)
+    targets = seq[:, 1:, None].astype(np.int64)
+    positions = np.broadcast_to(np.arange(seq_len),
+                                (batch, seq_len)).astype(np.int64)
+    return {"tokens": tokens,
+            "positions": np.ascontiguousarray(positions),
+            "targets": targets}
+
+
+def main():
+    batch = int(os.environ.get("BATCH", "16"))
+    seq_len = int(os.environ.get("SEQ_LEN", "32"))
+    vocab = int(os.environ.get("VOCAB", "64"))
+    d_model = int(os.environ.get("D_MODEL", "64"))
+    n_layer = int(os.environ.get("N_LAYER", "2"))
+    steps = int(os.environ.get("STEPS", "40"))
+
+    main_prog, startup, avg_loss, logits = build_transformer_program(
+        batch, seq_len, vocab, n_layer=n_layer, n_head=4,
+        d_model=d_model)
+    with fluid.program_guard(main_prog, startup):
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    first = last = None
+    for step in range(steps):
+        feed = synthetic_batch(rs, batch, seq_len, vocab)
+        (loss,) = exe.run(main_prog, feed=feed, fetch_list=[avg_loss])
+        last = float(np.asarray(loss).reshape(-1)[0])
+        if first is None:
+            first = last
+        if step % 10 == 0:
+            print("step %d loss %.4f" % (step, last), flush=True)
+    print("loss %.4f -> %.4f" % (first, last), flush=True)
+    assert last < first, "training did not reduce the loss"
+
+    # generation: the sliding-window step program carries the token
+    # window as decode state; per-program name scopes make its
+    # parameters line up with the trained program, so the SAME scope
+    # drives it (fluid.ProgramDecoder compiles the whole loop)
+    gen_batch, window = 4, seq_len
+    step_prog, _, step_logits, new_window = \
+        build_transformer_step_program(
+            gen_batch, window, vocab, n_layer=n_layer, n_head=4,
+            d_model=d_model)
+    decoder = fluid.ProgramDecoder(
+        step_prog.clone(for_test=True), token_name="tok",
+        logits_name=step_logits.name,
+        state_pairs=[("window", new_window.name),
+                     ("positions", "positions")])
+
+    # one shared prompt (start 5, step 3): the decoder's scalar `bos`
+    # is the prompt's true last token, so step 0 appends it and the
+    # first prediction continues the sequence
+    stride = 3
+    seq = (5 + stride * np.arange(window + 1)) % vocab
+    prompt = np.broadcast_to(seq[:window], (gen_batch, window))
+    positions = np.broadcast_to(np.arange(window),
+                                (gen_batch, window)).astype(np.int64)
+    toks, _ = decoder.greedy(
+        bos=int(seq[window]), eos=vocab + 1,  # no eos in this language
+        max_len=16,
+        init_state={"window": np.ascontiguousarray(prompt).astype(np.int64),
+                    "positions": np.ascontiguousarray(positions)})
+    gen = np.asarray(toks)[0].tolist()
+    print("prompt tail:", seq[window - 3:window + 1].tolist(), flush=True)
+    print("generated:  ", gen, flush=True)
+
+    # the learned language is arithmetic mod vocab: the continuation
+    # should keep stepping by `stride` far more often than chance
+    full = np.concatenate([[int(seq[window])], gen])
+    acc = float(np.mean((np.diff(full) % vocab) == stride))
+    print("pattern-follow accuracy: %.2f" % acc, flush=True)
+    # chance is 1/vocab ~ 0.016; a briefly-trained model lands well
+    # above it (deterministic seed)
+    assert acc > 0.15, acc
+
+
+if __name__ == "__main__":
+    main()

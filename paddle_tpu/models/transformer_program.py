@@ -19,7 +19,9 @@ import numpy as np
 
 from .. import fluid
 
-__all__ = ["build_transformer_program", "transformer_program_feeds"]
+__all__ = ["build_transformer_program",
+           "build_transformer_step_program",
+           "transformer_program_feeds"]
 
 
 def _block(x, n_head, d_model, d_ff, causal, sp_axis, sp_mode):
@@ -74,6 +76,63 @@ def build_transformer_program(batch, seq_len, vocab_size, n_layer=2,
         loss = fluid.layers.softmax_with_cross_entropy(flat, flat_tgt)
         avg_loss = fluid.layers.mean(x=loss)
     return main, startup, avg_loss, logits
+
+
+def build_transformer_step_program(batch, window, vocab_size, n_layer=2,
+                                   n_head=4, d_model=64, d_ff=None,
+                                   sp_axis="", sp_mode="ring"):
+    """Sliding-window decode step for `fluid.ProgramDecoder`.
+
+    Feeds: tok [batch] (the token the decoder just chose), window
+    [batch, window] int64 (the last `window` tokens), positions
+    [batch, window].  Fetches: logits [batch, vocab] for the NEXT
+    token, plus the shifted window — wire it as::
+
+        dec = fluid.ProgramDecoder(
+            prog.clone(for_test=True), token_name="tok",
+            logits_name=logits.name,
+            state_pairs=[("window", new_window.name),
+                         ("positions", "positions")])
+
+    Because name scopes are per Program, its parameters carry the SAME
+    names as a `build_transformer_program` of the same architecture
+    (the extra cast/split/concat ops create only temporaries), so a
+    scope trained by the training program drives this step program
+    directly.  A KV-cache step (O(1) work per token instead of
+    O(window)) is the long-context extension; the window form needs no
+    cache plumbing and is exact for contexts up to `window`.
+    """
+    if d_ff is None:
+        d_ff = 4 * d_model
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        tok = fluid.layers.data(name="tok", shape=[batch], dtype="int32",
+                                append_batch_size=False)
+        win = fluid.layers.data(name="window", shape=[batch, window],
+                                dtype="int64", append_batch_size=False)
+        positions = fluid.layers.data(
+            name="positions", shape=[batch, window], dtype="int64",
+            append_batch_size=False)
+
+        tok64 = fluid.layers.reshape(
+            x=fluid.layers.cast(tok, "int64"), shape=[batch, 1])
+        _, rest = fluid.layers.split(win, num_or_sections=[1, window - 1],
+                                     dim=1)
+        new_window = fluid.layers.concat([rest, tok64], axis=1)
+
+        x = fluid.layers.embedding(new_window,
+                                   size=[vocab_size, d_model]) \
+            + fluid.layers.embedding(positions, size=[window, d_model])
+        for _ in range(n_layer):
+            x = _block(x, n_head, d_model, d_ff, True, sp_axis, sp_mode)
+        x = fluid.layers.layer_norm(x, begin_norm_axis=2)
+        logits3 = fluid.layers.fc(input=x, size=vocab_size,
+                                  num_flatten_dims=2)
+        _, last = fluid.layers.split(
+            logits3, num_or_sections=[window - 1, 1], dim=1)
+        logits = fluid.layers.reshape(x=last, shape=[batch, vocab_size])
+    return main, startup, logits, new_window
 
 
 def transformer_program_feeds(batch, seq_len, vocab_size, seed=0):

@@ -39,6 +39,7 @@ def test_example_parses(path):
     ("dist_pserver_fit_a_line.py", {}),
     ("ctr_deepfm_sparse.py", {"FEATURES": "512", "FIELDS": "4",
                               "BATCH": "64", "STEPS": "15"}),
+    ("transformer_lm.py", {"STEPS": "60", "SEQ_LEN": "32"}),
 ], ids=lambda v: v if isinstance(v, str) else "")
 def test_example_runs(path, env):
     full_env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu",

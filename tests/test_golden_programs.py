@@ -95,12 +95,27 @@ def _build_deepfm():
     return fluid.default_main_program().desc.to_dict()
 
 
+def _build_transformer():
+    """Pins the flash_attention op's IR (attrs incl. the sp wiring) and
+    the fused momentum update of the Program-stack transformer."""
+    from paddle_tpu.models.transformer_program import \
+        build_transformer_program
+
+    main, startup, avg_loss, _ = build_transformer_program(
+        2, 8, 32, n_layer=1, n_head=2, d_model=16, sp_axis="sp")
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.01, momentum=0.9).minimize(avg_loss)
+    return main.desc.to_dict()
+
+
 CASES = {
     "fit_a_line": lambda: _build_fit_a_line().desc.to_dict(),
     "conv_classifier": lambda: _build_conv_classifier().desc.to_dict(),
     "dynamic_rnn": lambda: _build_dynamic_rnn().desc.to_dict(),
     "transpiled_pair": _build_transpiled_pair,
     "deepfm": _build_deepfm,
+    "transformer": _build_transformer,
 }
 
 
@@ -128,7 +143,7 @@ def test_golden_roundtrip():
     from paddle_tpu.core.desc import ProgramDesc
 
     for case in ("fit_a_line", "conv_classifier", "dynamic_rnn",
-                 "deepfm"):
+                 "deepfm", "transformer"):
         with open(os.path.join(GOLDEN_DIR, case + ".json")) as f:
             want = json.load(f)
         desc = ProgramDesc.from_dict(want)
