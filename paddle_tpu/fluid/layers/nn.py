@@ -28,8 +28,31 @@ __all__ = [
     "sequence_slice", "lod_reset", "edit_distance", "ctc_greedy_decoder",
     "sequence_concat", "beam_search", "beam_search_decode",
     "sequence_reverse", "sequence_unnest", "sequence_renest",
-    "flash_attention",
+    "flash_attention", "cached_attention",
 ]
+
+
+def cached_attention(query, key, value, k_cache, v_cache, position,
+                     num_heads=1, sm_scale=None, name=None):
+    """One KV-cached decode step (ops/attention.py cached_attention):
+    query/key/value [batch, 1, dim], caches [batch, heads, max_len,
+    head_dim], position int [1].  Returns (out, k_cache_out,
+    v_cache_out) — thread the cache outputs back as decode state
+    (`fluid.ProgramDecoder` state pairs)."""
+    helper = LayerHelper("cached_attention", name=name)
+    out = helper.create_tmp_variable(query.dtype)
+    kc_out = helper.create_tmp_variable(k_cache.dtype)
+    vc_out = helper.create_tmp_variable(v_cache.dtype)
+    helper.append_op(
+        type="cached_attention",
+        inputs={"Q": [query], "KNew": [key], "VNew": [value],
+                "KCache": [k_cache], "VCache": [v_cache],
+                "Position": [position]},
+        outputs={"Out": [out], "KCacheOut": [kc_out],
+                 "VCacheOut": [vc_out]},
+        attrs={"num_heads": int(num_heads),
+               "sm_scale": float(sm_scale or 0.0)})
+    return out, kc_out, vc_out
 
 
 def flash_attention(queries, keys, values, num_heads=1, causal=False,

@@ -21,6 +21,7 @@ from .. import fluid
 
 __all__ = ["build_transformer_program",
            "build_transformer_step_program",
+           "build_transformer_cached_step_program",
            "transformer_program_feeds"]
 
 
@@ -133,6 +134,88 @@ def build_transformer_step_program(batch, window, vocab_size, n_layer=2,
             logits3, num_or_sections=[window - 1, 1], dim=1)
         logits = fluid.layers.reshape(x=last, shape=[batch, vocab_size])
     return main, startup, logits, new_window
+
+
+def build_transformer_cached_step_program(batch, max_len, vocab_size,
+                                          n_layer=2, n_head=4,
+                                          d_model=64, d_ff=None):
+    """KV-cached decode step: O(1) attention work per generated token.
+
+    Feeds: tok [batch] int32, pos [batch] int64 (the slot being
+    written; per-row so beam expansion can repeat it — rows advance in
+    lockstep), per-layer caches k_cache_i/v_cache_i [batch, n_head,
+    max_len, d_head].  Fetches: logits [batch, vocab], pos+1, and the
+    updated caches.  Returns (main, startup, logits, state_pairs)
+    where state_pairs wires straight into `fluid.ProgramDecoder`
+    (greedy and beam).
+
+    Parameter names match `build_transformer_program` of the same
+    architecture (per-program name scopes; cache feeds and the
+    cast/reshape glue create no parameters), so the trained scope
+    drives this program directly — max_len must not exceed the trained
+    sequence length (the position embedding's extent).
+    """
+    if d_ff is None:
+        d_ff = 4 * d_model
+    d_head = d_model // n_head
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        tok = fluid.layers.data(name="tok", shape=[batch], dtype="int32",
+                                append_batch_size=False)
+        pos = fluid.layers.data(name="pos", shape=[-1], dtype="int64",
+                                append_batch_size=False)
+        caches = []
+        for i in range(n_layer):
+            caches.append((
+                fluid.layers.data(
+                    name="k_cache_%d" % i,
+                    shape=[batch, n_head, max_len, d_head],
+                    dtype="float32", append_batch_size=False),
+                fluid.layers.data(
+                    name="v_cache_%d" % i,
+                    shape=[batch, n_head, max_len, d_head],
+                    dtype="float32", append_batch_size=False)))
+
+        # lookup_table squeezes a trailing size-1 ids dim (reference
+        # convention), so [batch, 1, 1] ids yield [batch, 1, d]
+        tok64 = fluid.layers.reshape(
+            x=fluid.layers.cast(tok, "int64"), shape=[batch, 1, 1])
+        # rows move in lockstep: one wpe row serves the whole batch
+        pos_scalar = fluid.layers.reduce_max(pos)
+        pos_ids = fluid.layers.reshape(x=pos_scalar, shape=[1, 1, 1])
+        # wpe lookup is [1, 1, d]; the residual add broadcasts it over
+        # the batch
+        x = fluid.layers.embedding(tok64, size=[vocab_size, d_model]) \
+            + fluid.layers.embedding(pos_ids, size=[max_len, d_model])
+
+        state_pairs = []
+        for i in range(n_layer):
+            h = fluid.layers.layer_norm(x, begin_norm_axis=2)
+            qkv = fluid.layers.fc(input=h, size=3 * d_model,
+                                  num_flatten_dims=2)
+            q, k, v = fluid.layers.split(qkv, num_or_sections=3, dim=-1)
+            o, kc_out, vc_out = fluid.layers.cached_attention(
+                q, k, v, caches[i][0], caches[i][1], pos,
+                num_heads=n_head)
+            state_pairs.append(("k_cache_%d" % i, kc_out.name))
+            state_pairs.append(("v_cache_%d" % i, vc_out.name))
+            x = x + fluid.layers.fc(input=o, size=d_model,
+                                    num_flatten_dims=2)
+            h = fluid.layers.layer_norm(x, begin_norm_axis=2)
+            h = fluid.layers.fc(input=h, size=d_ff, num_flatten_dims=2,
+                                act="relu")
+            x = x + fluid.layers.fc(input=h, size=d_model,
+                                    num_flatten_dims=2)
+
+        x = fluid.layers.layer_norm(x, begin_norm_axis=2)
+        logits3 = fluid.layers.fc(input=x, size=vocab_size,
+                                  num_flatten_dims=2)
+        logits = fluid.layers.reshape(x=logits3,
+                                      shape=[batch, vocab_size])
+        pos_out = fluid.layers.increment(pos, value=1, in_place=False)
+        state_pairs.append(("pos", pos_out.name))
+    return main, startup, logits, state_pairs
 
 
 def transformer_program_feeds(batch, seq_len, vocab_size, seed=0):

@@ -86,3 +86,50 @@ def flash_attention_op(ctx, ins, attrs):
         out = flash_attention(qh, kh, vh, sm_scale, causal,
                               block_q=block, block_k=block)
     return {"Out": [_merge_heads(out).astype(q.dtype)]}
+
+
+@register_op("cached_attention", stop_gradient_op=True)
+def cached_attention_op(ctx, ins, attrs):
+    """One autoregressive decode step with a KV cache: O(1) work per
+    token instead of re-attending the whole window.
+
+    Q/KNew/VNew: [batch, 1, dim] (this token's projections);
+    KCache/VCache: [batch, heads, max_len, head_dim]; Position: int
+    [1] or [batch] (lockstep rows), the slot this step writes (tokens
+    0..Position attend).
+    Outputs the attended context [batch, 1, dim] and the updated
+    caches — wire them as ProgramDecoder state pairs.  Generation
+    never needs gradients (matching the reference's host-side
+    generation loop), so the op stops them.
+    """
+    import jax.numpy as jnp
+
+    q, k_new, v_new = ins["Q"][0], ins["KNew"][0], ins["VNew"][0]
+    k_cache, v_cache = ins["KCache"][0], ins["VCache"][0]
+    # Position may be [1] or per-row [batch] (rows advance in lockstep;
+    # a per-row vector is what beam expansion produces)
+    pos = jnp.reshape(ins["Position"][0], (-1,))[0].astype(jnp.int32)
+    num_heads = int(attrs.get("num_heads", 1))
+    sm_scale = float(attrs.get("sm_scale", 0.0)) or None
+
+    qh = _split_heads(q, num_heads)            # [B, H, 1, Dh]
+    kh = _split_heads(k_new, num_heads)
+    vh = _split_heads(v_new, num_heads)
+    if sm_scale is None:
+        sm_scale = qh.shape[-1] ** -0.5
+
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, kh.astype(k_cache.dtype), pos, axis=2)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, vh.astype(v_cache.dtype), pos, axis=2)
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * sm_scale
+    T = k_cache.shape[2]
+    valid = jnp.arange(T) <= pos
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v_cache.astype(jnp.float32))
+    return {"Out": [_merge_heads(out).astype(q.dtype)],
+            "KCacheOut": [k_cache], "VCacheOut": [v_cache]}

@@ -1,0 +1,127 @@
+"""KV-cached transformer decoding (cached_attention op).
+
+The cached step program re-uses the scope trained by the full training
+program (per-program name scopes align the parameters), and its O(1)
+per-token attention must agree with the full causal forward: after
+greedy generation through `fluid.ProgramDecoder`, every generated
+token equals the argmax of the training program's logits at the
+corresponding position of the final sequence (teacher-forced check —
+if the cache scattered or masked wrongly, the trajectories diverge).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import scope_guard, global_scope
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.models.transformer_program import (
+    build_transformer_program, build_transformer_cached_step_program,
+    transformer_program_feeds)
+
+B, T, V, L, H, D = 4, 16, 32, 2, 2, 16
+
+
+def _train(steps=6):
+    main, startup, avg_loss, _ = build_transformer_program(
+        B, T, V, n_layer=L, n_head=H, d_model=D)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg_loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for i in range(steps):
+        exe.run(main, feed=transformer_program_feeds(B, T, V, seed=i),
+                fetch_list=[avg_loss])
+    return exe
+
+
+def test_cached_decode_matches_full_forward():
+    with scope_guard(Scope()):
+        exe = _train()
+
+        step_prog, _, logits, state_pairs = \
+            build_transformer_cached_step_program(
+                B, T, V, n_layer=L, n_head=H, d_model=D)
+        dec = fluid.ProgramDecoder(
+            step_prog.clone(for_test=True), token_name="tok",
+            logits_name=logits.name, state_pairs=state_pairs)
+
+        bos, gen_len = 3, 8
+        d_head = D // H
+        init = {"pos": np.zeros((B,), np.int64)}
+        for i in range(L):
+            init["k_cache_%d" % i] = np.zeros((B, H, T, d_head),
+                                              np.float32)
+            init["v_cache_%d" % i] = np.zeros((B, H, T, d_head),
+                                              np.float32)
+        toks, _ = dec.greedy(bos=bos, eos=V + 1, max_len=gen_len,
+                             batch_size=B, init_state=init)
+        assert toks.shape == (B, gen_len)
+
+        # teacher-forced check against the FULL training program: at
+        # position t the causal forward of [bos, toks[:-1]] must argmax
+        # to toks[t]
+        full = np.concatenate(
+            [np.full((B, 1), bos, np.int64), toks[:, :-1]], axis=1)
+        pad = np.zeros((B, T - full.shape[1]), np.int64)
+        tokens = np.concatenate([full, pad], axis=1)
+        infer_main, _, _, full_logits = build_transformer_program(
+            B, T, V, n_layer=L, n_head=H, d_model=D)
+        got_logits, = exe.run(
+            infer_main.clone(for_test=True),
+            feed={"tokens": tokens,
+                  "positions": transformer_program_feeds(
+                      B, T, V)["positions"],
+                  "targets": np.zeros((B, T, 1), np.int64)},
+            fetch_list=[full_logits])
+        got_logits = np.asarray(got_logits)
+        for t in range(gen_len):
+            want = np.argmax(got_logits[:, t, :], axis=-1)
+            np.testing.assert_array_equal(toks[:, t], want,
+                                          err_msg="position %d" % t)
+
+        # beam over the cached program: state expansion repeats the
+        # per-row pos/caches; beam(1) equals greedy
+        seqs, scores = dec.beam(beam_size=1, bos=bos, eos=V + 1,
+                                max_len=gen_len, batch_size=B,
+                                init_state=init)
+        np.testing.assert_array_equal(seqs[:, 0, :], toks)
+        assert np.all(np.isfinite(scores))
+
+
+def test_cached_attention_op_matches_dense_reference():
+    """Direct op check: running the cache step T times equals dense
+    causal attention over the same sequence."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op_info
+    from paddle_tpu.kernels.flash_attention import reference_attention
+
+    rs = np.random.RandomState(0)
+    b, h, t, dh = 2, 2, 6, 4
+    d = h * dh
+    q = rs.randn(b, t, d).astype(np.float32)
+    k = rs.randn(b, t, d).astype(np.float32)
+    v = rs.randn(b, t, d).astype(np.float32)
+
+    kernel = get_op_info("cached_attention").kernel
+    kc = jnp.zeros((b, h, t, dh))
+    vc = jnp.zeros((b, h, t, dh))
+    outs = []
+    for pos in range(t):
+        r = kernel(None, {
+            "Q": [jnp.asarray(q[:, pos:pos + 1])],
+            "KNew": [jnp.asarray(k[:, pos:pos + 1])],
+            "VNew": [jnp.asarray(v[:, pos:pos + 1])],
+            "KCache": [kc], "VCache": [vc],
+            "Position": [jnp.asarray([pos])]}, {"num_heads": h})
+        kc, vc = r["KCacheOut"][0], r["VCacheOut"][0]
+        outs.append(np.asarray(r["Out"][0]))
+    got = np.concatenate(outs, axis=1)          # [b, t, d]
+
+    def heads(x):
+        return x.reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+
+    ref = reference_attention(jnp.asarray(heads(q)),
+                              jnp.asarray(heads(k)),
+                              jnp.asarray(heads(v)), None, True)
+    ref = np.asarray(ref).transpose(0, 2, 1, 3).reshape(b, t, d)
+    np.testing.assert_allclose(got, ref, atol=2e-5)
