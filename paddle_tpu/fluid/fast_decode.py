@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from ..jit import FunctionalProgram, state_from_scope
-from ..models.decode import greedy_decode, beam_search_decode_dense
+from ..models.decode import (greedy_decode, beam_search_decode_dense,
+                             prefill)
 
 __all__ = ["ProgramDecoder"]
 
@@ -47,9 +48,14 @@ class ProgramDecoder:
     """
 
     def __init__(self, program, token_name, logits_name, state_pairs=(),
-                 scope=None):
+                 scope=None, max_positions=None):
         self.token_name = token_name
         self.state_pairs = list(state_pairs)
+        # the step program's position extent (KV-cache length /
+        # position-embedding table size): writes past it would CLAMP
+        # inside the compiled scatter and silently corrupt generation,
+        # so greedy/beam validate against it up front when it is given
+        self.max_positions = max_positions
         feed_names = [token_name] + [f for f, _ in self.state_pairs]
         fetch_names = [logits_name] + [o for _, o in self.state_pairs]
         self._fp = FunctionalProgram(program, feed_names, fetch_names)
@@ -104,22 +110,66 @@ class ProgramDecoder:
             self._compiled[key] = jax.jit(builder())
         return self._compiled[key]
 
-    def greedy(self, bos, eos, max_len, batch_size=None, init_state=None):
-        """Returns (tokens [batch, max_len], lengths [batch])."""
+    def _check_extent(self, max_len, prompt_len=0):
+        if self.max_positions is None:
+            return
+        need = prompt_len + max_len - 1 if prompt_len else max_len
+        if need > self.max_positions:
+            raise ValueError(
+                "decoding %d positions (prompt %d + %d generated) "
+                "exceeds the step program's extent %d — the compiled "
+                "scatter would clamp and corrupt the cache"
+                % (need, prompt_len, max_len, self.max_positions))
+
+    def greedy(self, bos, eos, max_len, batch_size=None, init_state=None,
+               prompt=None):
+        """Returns (tokens [batch, max_len], lengths [batch]).
+
+        `prompt` (int [batch, P]) warms the decode state through the
+        step program first (one scan — for a KV-cache step program this
+        is the prefill); the first output token is then the prompt's
+        continuation and `bos` is ignored."""
         state, batch_size = self._prep(init_state, batch_size)
+        self._check_extent(max_len,
+                           0 if prompt is None else
+                           np.asarray(prompt).shape[1])
+        if prompt is None:
+            fn = self._jitted(
+                ("greedy", bos, eos, max_len, batch_size),
+                lambda: lambda params, s: greedy_decode(
+                    self._step_fn(params), s, bos=bos, eos=eos,
+                    max_len=max_len, batch_size=batch_size))
+            toks, lengths = fn(self._params, state)
+            return np.asarray(toks), np.asarray(lengths)
+
+        prompt = np.asarray(prompt)
         fn = self._jitted(
-            ("greedy", bos, eos, max_len, batch_size),
-            lambda: lambda params, s: greedy_decode(
-                self._step_fn(params), s, bos=bos, eos=eos,
-                max_len=max_len, batch_size=batch_size))
-        toks, lengths = fn(self._params, state)
+            ("greedy-prefill", eos, max_len, batch_size,
+             prompt.shape[1]),
+            lambda: lambda params, s, p: self._prefilled_greedy(
+                params, s, p, eos, max_len, batch_size))
+        toks, lengths = fn(self._params, state, jnp.asarray(prompt))
         return np.asarray(toks), np.asarray(lengths)
+
+    def _prefilled_greedy(self, params, state, prompt, eos, max_len,
+                          batch_size):
+        step = self._step_fn(params)
+        state, first = prefill(step, state, prompt)
+        toks, _ = greedy_decode(step, state, bos=first, eos=eos,
+                                max_len=max_len - 1,
+                                batch_size=batch_size)
+        toks = jnp.concatenate([first[:, None], toks], axis=1)
+        lengths = jnp.argmax(toks == eos, axis=1) + 1
+        lengths = jnp.where(jnp.any(toks == eos, axis=1), lengths,
+                            max_len)
+        return toks, lengths
 
     def beam(self, beam_size, bos, eos, max_len, batch_size=None,
              init_state=None, length_penalty=0.0):
         """Returns (sequences [batch, beam, max_len], scores
         [batch, beam]), best first."""
         state, batch_size = self._prep(init_state, batch_size)
+        self._check_extent(max_len)
         fn = self._jitted(
             ("beam", beam_size, bos, eos, max_len, batch_size,
              length_penalty),

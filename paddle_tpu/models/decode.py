@@ -14,14 +14,36 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["greedy_decode", "beam_search_decode_dense"]
+__all__ = ["greedy_decode", "beam_search_decode_dense", "prefill"]
 
 NEG_INF = -1e30
 
 
+def prefill(step_fn, init_state, prompt):
+    """Feed a prompt through the step function (one scan), returning
+    (state, first_token) where first_token [B] is the argmax of the
+    last prompt position's logits — the natural continuation to seed
+    the decode with.  prompt: int [B, P].
+
+    Only the LAST logits ride the scan carry (the first step runs
+    outside to shape the carry leaf), so prefill memory is O(B*V)
+    regardless of prompt length."""
+    toks = jnp.moveaxis(jnp.asarray(prompt, jnp.int32), 0, 1)  # [P, B]
+    logits, state = step_fn(init_state, toks[0])
+
+    def body(carry, tok):
+        state, _ = carry
+        logits, state = step_fn(state, tok)
+        return (state, logits), None
+
+    (state, logits), _ = jax.lax.scan(body, (state, logits), toks[1:])
+    return state, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def greedy_decode(step_fn, init_state, bos, eos, max_len, batch_size):
     """step_fn(state, tokens[B]) -> (logits [B,V], new_state).
-    Returns (tokens [B, max_len], lengths [B])."""
+    Returns (tokens [B, max_len], lengths [B]).  `bos` may be a scalar
+    or a per-row [B] array (e.g. prefill's first_token)."""
 
     def body(carry, _):
         state, tok, done = carry
@@ -31,8 +53,13 @@ def greedy_decode(step_fn, init_state, bos, eos, max_len, batch_size):
         done = done | (nxt == eos)
         return (state, nxt, done), nxt
 
-    tok0 = jnp.full((batch_size,), bos, jnp.int32)
-    done0 = jnp.zeros((batch_size,), bool)
+    bos = jnp.asarray(bos, jnp.int32)
+    tok0 = jnp.broadcast_to(bos, (batch_size,))
+    # per-row seeds (prefill continuations) that are already eos emit
+    # eos throughout; a SCALAR bos may deliberately equal eos (the
+    # GPT-2 endoftext convention) and must still generate
+    done0 = (tok0 == eos) if bos.ndim else \
+        jnp.zeros((batch_size,), bool)
     (_, _, done), toks = jax.lax.scan(body, (init_state, tok0, done0),
                                       None, length=max_len)
     toks = jnp.moveaxis(toks, 0, 1)               # [B, L]
@@ -56,7 +83,7 @@ def beam_search_decode_dense(step_fn, init_state, bos, eos, beam_size,
         return jnp.repeat(t, K, axis=0)
 
     state = jax.tree_util.tree_map(expand, init_state)
-    tok = jnp.full((B * K,), bos, jnp.int32)
+    tok = expand(jnp.broadcast_to(jnp.asarray(bos, jnp.int32), (B,)))
     # only beam 0 alive at t=0 so the first top-k doesn't pick K copies
     scores = jnp.tile(jnp.concatenate(
         [jnp.zeros((1,), jnp.float32),

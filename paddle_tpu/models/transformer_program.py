@@ -147,7 +147,8 @@ def build_transformer_cached_step_program(batch, max_len, vocab_size,
     max_len, d_head].  Fetches: logits [batch, vocab], pos+1, and the
     updated caches.  Returns (main, startup, logits, state_pairs)
     where state_pairs wires straight into `fluid.ProgramDecoder`
-    (greedy and beam).
+    (greedy and beam; pass max_positions=max_len so decoding past the
+    cache extent errors instead of clamping).
 
     Parameter names match `build_transformer_program` of the same
     architecture (per-program name scopes; cache feeds and the

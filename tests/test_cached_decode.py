@@ -88,6 +88,64 @@ def test_cached_decode_matches_full_forward():
         assert np.all(np.isfinite(scores))
 
 
+def test_cached_prefill_continuation_matches_full_forward():
+    """Prompt prefill: warm the caches with a prompt in one scan, then
+    generate — every token (incl. the first, predicted from the prompt)
+    must match the full causal forward teacher-forced on the combined
+    sequence."""
+    with scope_guard(Scope()):
+        exe = _train()
+
+        step_prog, _, logits, state_pairs = \
+            build_transformer_cached_step_program(
+                B, T, V, n_layer=L, n_head=H, d_model=D)
+        dec = fluid.ProgramDecoder(
+            step_prog.clone(for_test=True), token_name="tok",
+            logits_name=logits.name, state_pairs=state_pairs,
+            max_positions=T)
+
+        P, gen_len = 5, 6
+        d_head = D // H
+        rs = np.random.RandomState(7)
+        prompt = rs.randint(0, V, size=(B, P)).astype(np.int64)
+        init = {"pos": np.zeros((B,), np.int64)}
+        for i in range(L):
+            init["k_cache_%d" % i] = np.zeros((B, H, T, d_head),
+                                              np.float32)
+            init["v_cache_%d" % i] = np.zeros((B, H, T, d_head),
+                                              np.float32)
+        toks, _ = dec.greedy(bos=0, eos=V + 1, max_len=gen_len,
+                             batch_size=B, init_state=init,
+                             prompt=prompt)
+        assert toks.shape == (B, gen_len)
+
+        # overrunning the cache extent is an error, not silent clamping
+        import pytest
+        with pytest.raises(ValueError, match="extent"):
+            dec.greedy(bos=0, eos=V + 1, max_len=T + 2, batch_size=B,
+                       init_state=init, prompt=prompt)
+
+        # teacher-forced: full forward over [prompt, toks[:-1]]; the
+        # argmax at positions P-1 .. P+gen_len-2 must reproduce toks
+        seq = np.concatenate([prompt, toks[:, :-1]], axis=1)
+        tokens = np.concatenate(
+            [seq, np.zeros((B, T - seq.shape[1]), np.int64)], axis=1)
+        infer_main, _, _, full_logits = build_transformer_program(
+            B, T, V, n_layer=L, n_head=H, d_model=D)
+        got_logits, = exe.run(
+            infer_main.clone(for_test=True),
+            feed={"tokens": tokens,
+                  "positions": transformer_program_feeds(
+                      B, T, V)["positions"],
+                  "targets": np.zeros((B, T, 1), np.int64)},
+            fetch_list=[full_logits])
+        got_logits = np.asarray(got_logits)
+        for t in range(gen_len):
+            want = np.argmax(got_logits[:, P - 1 + t, :], axis=-1)
+            np.testing.assert_array_equal(toks[:, t], want,
+                                          err_msg="position %d" % t)
+
+
 def test_cached_attention_op_matches_dense_reference():
     """Direct op check: running the cache step T times equals dense
     causal attention over the same sequence."""
