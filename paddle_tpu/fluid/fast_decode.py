@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ..jit import FunctionalProgram, state_from_scope
 from ..models.decode import (greedy_decode, beam_search_decode_dense,
-                             prefill)
+                             prefill, sample_decode)
 
 __all__ = ["ProgramDecoder"]
 
@@ -121,6 +121,37 @@ class ProgramDecoder:
                 "scatter would clamp and corrupt the cache"
                 % (need, prompt_len, max_len, self.max_positions))
 
+    def _norm_prompt(self, prompt, max_len):
+        """Validate and convert the optional prompt once; returns a
+        numpy array or None."""
+        if prompt is None:
+            self._check_extent(max_len)
+            return None
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 2 or prompt.shape[1] == 0:
+            raise ValueError(
+                "prompt must be [batch, P>=1] tokens, got shape %s"
+                % (prompt.shape,))
+        self._check_extent(max_len, prompt.shape[1])
+        return prompt
+
+    def _prefilled_run(self, params, state, prompt, decode_fn, eos,
+                      max_len):
+        """Shared prompt path: prefill, then decode_fn(step, state,
+        first) for the remaining max_len-1 tokens (skipped when
+        max_len == 1 — the 'predict one continuation token' call)."""
+        step = self._step_fn(params)
+        state, first = prefill(step, state, prompt)
+        if max_len == 1:
+            toks = first[:, None]
+        else:
+            toks, _ = decode_fn(step, state, first)
+            toks = jnp.concatenate([first[:, None], toks], axis=1)
+        lengths = jnp.argmax(toks == eos, axis=1) + 1
+        lengths = jnp.where(jnp.any(toks == eos, axis=1), lengths,
+                            max_len)
+        return toks, lengths
+
     def greedy(self, bos, eos, max_len, batch_size=None, init_state=None,
                prompt=None):
         """Returns (tokens [batch, max_len], lengths [batch]).
@@ -130,9 +161,7 @@ class ProgramDecoder:
         is the prefill); the first output token is then the prompt's
         continuation and `bos` is ignored."""
         state, batch_size = self._prep(init_state, batch_size)
-        self._check_extent(max_len,
-                           0 if prompt is None else
-                           np.asarray(prompt).shape[1])
+        prompt = self._norm_prompt(prompt, max_len)
         if prompt is None:
             fn = self._jitted(
                 ("greedy", bos, eos, max_len, batch_size),
@@ -142,27 +171,49 @@ class ProgramDecoder:
             toks, lengths = fn(self._params, state)
             return np.asarray(toks), np.asarray(lengths)
 
-        prompt = np.asarray(prompt)
         fn = self._jitted(
             ("greedy-prefill", eos, max_len, batch_size,
              prompt.shape[1]),
-            lambda: lambda params, s, p: self._prefilled_greedy(
-                params, s, p, eos, max_len, batch_size))
+            lambda: lambda params, s, p: self._prefilled_run(
+                params, s, p,
+                lambda step, st, first: greedy_decode(
+                    step, st, bos=first, eos=eos, max_len=max_len - 1,
+                    batch_size=batch_size),
+                eos, max_len))
         toks, lengths = fn(self._params, state, jnp.asarray(prompt))
         return np.asarray(toks), np.asarray(lengths)
 
-    def _prefilled_greedy(self, params, state, prompt, eos, max_len,
-                          batch_size):
-        step = self._step_fn(params)
-        state, first = prefill(step, state, prompt)
-        toks, _ = greedy_decode(step, state, bos=first, eos=eos,
-                                max_len=max_len - 1,
-                                batch_size=batch_size)
-        toks = jnp.concatenate([first[:, None], toks], axis=1)
-        lengths = jnp.argmax(toks == eos, axis=1) + 1
-        lengths = jnp.where(jnp.any(toks == eos, axis=1), lengths,
-                            max_len)
-        return toks, lengths
+    def sample(self, bos, eos, max_len, batch_size=None, init_state=None,
+               prompt=None, seed=0, temperature=1.0, top_k=0):
+        """Ancestral sampling (temperature / top-k).  With `prompt`,
+        prefills first and samples the continuation."""
+        state, batch_size = self._prep(init_state, batch_size)
+        prompt = self._norm_prompt(prompt, max_len)
+        key = ("sample", eos, max_len, batch_size, temperature, top_k,
+               None if prompt is None else prompt.shape[1],
+               bos if prompt is None else None)
+        if prompt is None:
+            fn = self._jitted(key, lambda: lambda params, s, rng:
+                              sample_decode(
+                                  self._step_fn(params), s, bos=bos,
+                                  eos=eos, max_len=max_len,
+                                  batch_size=batch_size, rng=rng,
+                                  temperature=temperature, top_k=top_k))
+            toks, lengths = fn(self._params, state,
+                               jax.random.PRNGKey(seed))
+        else:
+            fn = self._jitted(
+                key,
+                lambda: lambda params, s, p, rng: self._prefilled_run(
+                    params, s, p,
+                    lambda step, st, first: sample_decode(
+                        step, st, bos=first, eos=eos,
+                        max_len=max_len - 1, batch_size=batch_size,
+                        rng=rng, temperature=temperature, top_k=top_k),
+                    eos, max_len))
+            toks, lengths = fn(self._params, state, jnp.asarray(prompt),
+                               jax.random.PRNGKey(seed))
+        return np.asarray(toks), np.asarray(lengths)
 
     def beam(self, beam_size, bos, eos, max_len, batch_size=None,
              init_state=None, length_penalty=0.0):

@@ -14,7 +14,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["greedy_decode", "beam_search_decode_dense", "prefill"]
+__all__ = ["greedy_decode", "beam_search_decode_dense", "prefill",
+           "sample_decode"]
 
 NEG_INF = -1e30
 
@@ -63,6 +64,40 @@ def greedy_decode(step_fn, init_state, bos, eos, max_len, batch_size):
     (_, _, done), toks = jax.lax.scan(body, (init_state, tok0, done0),
                                       None, length=max_len)
     toks = jnp.moveaxis(toks, 0, 1)               # [B, L]
+    lengths = jnp.argmax(toks == eos, axis=1) + 1
+    lengths = jnp.where(jnp.any(toks == eos, axis=1), lengths, max_len)
+    return toks, lengths
+
+
+def sample_decode(step_fn, init_state, bos, eos, max_len, batch_size,
+                  rng, temperature=1.0, top_k=0):
+    """Ancestral sampling under jit: per-step categorical draw from
+    the (temperature-scaled, optionally top-k-truncated) logits.
+    Returns (tokens [B, max_len], lengths [B]).  `rng` is a JAX PRNG
+    key; `bos` may be scalar or per-row (prefill seed)."""
+
+    def body(carry, _):
+        state, tok, done, key = carry
+        logits, state = step_fn(state, tok)
+        logits = logits.astype(jnp.float32) / jnp.maximum(
+            temperature, 1e-6)
+        if top_k:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, NEG_INF, logits)
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(sub, logits, axis=-1) \
+            .astype(jnp.int32)
+        nxt = jnp.where(done, eos, nxt)
+        done = done | (nxt == eos)
+        return (state, nxt, done, key), nxt
+
+    bos = jnp.asarray(bos, jnp.int32)
+    tok0 = jnp.broadcast_to(bos, (batch_size,))
+    done0 = (tok0 == eos) if bos.ndim else \
+        jnp.zeros((batch_size,), bool)
+    (_, _, done, _), toks = jax.lax.scan(
+        body, (init_state, tok0, done0, rng), None, length=max_len)
+    toks = jnp.moveaxis(toks, 0, 1)
     lengths = jnp.argmax(toks == eos, axis=1) + 1
     lengths = jnp.where(jnp.any(toks == eos, axis=1), lengths, max_len)
     return toks, lengths

@@ -125,6 +125,26 @@ def test_cached_prefill_continuation_matches_full_forward():
             dec.greedy(bos=0, eos=V + 1, max_len=T + 2, batch_size=B,
                        init_state=init, prompt=prompt)
 
+        # prompted sampling at near-zero temperature reproduces the
+        # prompted greedy trajectory through the same caches
+        cold, _ = dec.sample(bos=0, eos=V + 1, max_len=gen_len,
+                             batch_size=B, init_state=init,
+                             prompt=prompt, temperature=1e-5)
+        np.testing.assert_array_equal(cold, toks)
+
+        # max_len=1: just the prompt's single continuation token
+        one, one_len = dec.greedy(bos=0, eos=V + 1, max_len=1,
+                                  batch_size=B, init_state=init,
+                                  prompt=prompt)
+        np.testing.assert_array_equal(one[:, 0], toks[:, 0])
+        assert one.shape == (B, 1)
+
+        # empty prompts are rejected up front
+        with pytest.raises(ValueError, match="P>=1"):
+            dec.greedy(bos=0, eos=V + 1, max_len=2, batch_size=B,
+                       init_state=init,
+                       prompt=np.zeros((B, 0), np.int64))
+
         # teacher-forced: full forward over [prompt, toks[:-1]]; the
         # argmax at positions P-1 .. P+gen_len-2 must reproduce toks
         seq = np.concatenate([prompt, toks[:, :-1]], axis=1)

@@ -95,6 +95,34 @@ def test_program_decoder_matches_executor_loop():
     assert np.all(np.isfinite(scores))
 
 
+def test_program_decoder_sampling():
+    """Temperature→0 sampling converges to greedy; temperature 1 with
+    different seeds diversifies; top_k=1 equals greedy by definition."""
+    main, startup, tok, h_in, h_out, logits = _build_step_program()
+    _train(main, startup, logits.name)
+    dec = fluid.ProgramDecoder(main, token_name="tok",
+                               logits_name=logits.name,
+                               state_pairs=[("h_in", h_out.name)])
+    batch, max_len = 5, 10
+    init = {"h_in": np.zeros((batch, H), np.float32)}
+
+    greedy, _ = dec.greedy(bos=BOS, eos=EOS, max_len=max_len,
+                           init_state=init)
+    cold, _ = dec.sample(bos=BOS, eos=EOS, max_len=max_len,
+                         init_state=init, temperature=1e-5)
+    np.testing.assert_array_equal(cold, greedy)
+    top1, _ = dec.sample(bos=BOS, eos=EOS, max_len=max_len,
+                         init_state=init, top_k=1)
+    np.testing.assert_array_equal(top1, greedy)
+
+    a, _ = dec.sample(bos=BOS, eos=EOS, max_len=max_len,
+                      init_state=init, seed=1, temperature=1.5)
+    b, _ = dec.sample(bos=BOS, eos=EOS, max_len=max_len,
+                      init_state=init, seed=2, temperature=1.5)
+    assert not np.array_equal(a, b), "different seeds should diverge"
+    assert ((a >= 0) & (a < V)).all()
+
+
 def test_program_decoder_beam_orders_scores():
     main, startup, tok, h_in, h_out, logits = _build_step_program()
     _train(main, startup, logits.name)
