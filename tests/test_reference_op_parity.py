@@ -20,23 +20,35 @@ REFERENCE_OPS_DIR = "/root/reference/paddle/operators"
 # capabilities delivered by the architecture rather than an op kernel:
 # NCCL/send/recv are XLA GSPMD collectives + the native pserver
 # transport; parallel_do is the dp mesh axis; rnn_memory_helper is the
-# recurrent op's scan carries; cond_op's legacy Python wrapper never
-# shipped beyond the op itself.
+# recurrent op's scan carries.
 SUBSUMED = {
     "ncclAllReduce", "ncclBcast", "ncclReduce", "ncclInit", "nccl",
     "send", "recv", "parallel_do",
     "rnn_memory_helper", "rnn_memory_helper_grad",
+    # macro parameter inside reduce_op.cc's kernel-registration helper,
+    # not an op name
+    "reduce_type",
 }
+
+# several reference ops register CPU kernels through a different macro
+# than their op registration (e.g. CPU-only ops) — scan all of them
+_PATTERNS = [re.compile(p) for p in (
+    r"REGISTER_OP\s*\(\s*([a-z0-9_]+)",
+    r"REGISTER_OP_WITHOUT_GRADIENT\s*\(\s*([a-z0-9_]+)",
+    r"REGISTER_OP_EX\s*\(\s*([a-z0-9_]+)",
+    r"REGISTER_OPERATOR\s*\(\s*([a-z0-9_]+)",
+    r"REGISTER_OP_CPU_KERNEL\s*\(\s*([a-z0-9_]+)",
+)]
 
 
 def _reference_op_names():
     names = set()
-    pattern = re.compile(
-        r"REGISTER_OP(?:_WITHOUT_GRADIENT|_EX)?\s*\(\s*([a-z0-9_]+)")
-    for path in glob.glob(os.path.join(REFERENCE_OPS_DIR, "**", "*.cc"),
+    for path in glob.glob(os.path.join(REFERENCE_OPS_DIR, "**", "*.c*"),
                           recursive=True):
         with open(path, errors="ignore") as f:
-            for m in pattern.finditer(f.read()):
+            src = f.read()
+        for pattern in _PATTERNS:
+            for m in pattern.finditer(src):
                 names.add(m.group(1))
     return names
 
@@ -45,7 +57,7 @@ def _reference_op_names():
                     reason="reference checkout not present")
 def test_every_reference_op_is_covered():
     ref = _reference_op_names()
-    assert len(ref) > 100, "extraction regressed: %d names" % len(ref)
+    assert len(ref) > 200, "extraction regressed: %d names" % len(ref)
     ours = set(registered_ops())
     missing = sorted(n for n in ref
                      if n not in ours and n not in SUBSUMED
