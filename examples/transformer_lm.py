@@ -113,6 +113,15 @@ def main():
     # above it (deterministic seed)
     assert acc > 0.15, acc
 
+    # same decoder, stochastic: temperature sampling diversifies
+    sampled, _ = decoder.sample(
+        bos=int(seq[window]), eos=vocab + 1, max_len=16,
+        init_state={"window": np.ascontiguousarray(prompt)
+                    .astype(np.int64),
+                    "positions": np.ascontiguousarray(positions)},
+        seed=1, temperature=1.2)
+    print("sampled:    ", np.asarray(sampled)[0].tolist(), flush=True)
+
 
 if __name__ == "__main__":
     main()
