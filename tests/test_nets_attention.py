@@ -67,3 +67,39 @@ def test_scaled_dot_product_attention_dynamic_batch():
     np.testing.assert_allclose(np.asarray(out),
                                _np_attention(xn, xn, xn, heads),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_scaled_dot_product_attention_flash_path():
+    """use_flash=True lowers to the fused op and agrees with the
+    composed path (cross-attention shapes, both head counts)."""
+    b, tq, tk, d = 2, 4, 6, 8
+    rs = np.random.RandomState(3)
+    qn = rs.randn(b, tq, d).astype(np.float32)
+    kn = rs.randn(b, tk, d).astype(np.float32)
+    vn = rs.randn(b, tk, d).astype(np.float32)
+
+    q = fluid.layers.data(name="q", shape=[b, tq, d], dtype="float32",
+                          append_batch_size=False)
+    k = fluid.layers.data(name="k", shape=[b, tk, d], dtype="float32",
+                          append_batch_size=False)
+    v = fluid.layers.data(name="v", shape=[b, tk, d], dtype="float32",
+                          append_batch_size=False)
+    for heads in (1, 2):
+        composed = nets.scaled_dot_product_attention(q, k, v,
+                                                     num_heads=heads)
+        fused = nets.scaled_dot_product_attention(q, k, v,
+                                                  num_heads=heads,
+                                                  use_flash=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        a, b_out = exe.run(fluid.default_main_program(),
+                           feed={"q": qn, "k": kn, "v": vn},
+                           fetch_list=[composed, fused])
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_out),
+                                   rtol=2e-5, atol=2e-6)
+
+    import pytest
+    with pytest.raises(ValueError, match="dropout"):
+        nets.scaled_dot_product_attention(q, k, v, num_heads=2,
+                                          dropout_rate=0.1,
+                                          use_flash=True)
