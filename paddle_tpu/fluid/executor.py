@@ -501,7 +501,8 @@ class Executor:
         # reuse executables traced under the old policy
         key = (program._cache_token, program.version, 0,
                tuple(sorted(feed_env.keys())), tuple(fetch_names),
-               flags.get_flag("amp_bf16"), flags.get_flag("amp_bf16_act"))
+               flags.get_flag("amp_bf16"), flags.get_flag("amp_bf16_act"),
+               flags.get_flag("bn_shifted_stats"))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = _CompiledProgram(self, program, 0,

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op, register_grad_kernel
+from ..utils import flags
 
 
 def _bn_axes(x, layout):
@@ -34,6 +35,10 @@ def _bn_stats(x, axes):
     element: free to read, and any value near the data keeps the
     cancellation benign; max(., 0) guards the round-off edge."""
     xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    if not flags.get_flag("bn_shifted_stats"):
+        m = jnp.mean(xs, axis=axes)
+        msq = jnp.mean(jnp.square(xs), axis=axes)
+        return m, jnp.maximum(msq - jnp.square(m), 0.0)
     first = tuple(slice(0, 1) if i in axes else slice(None)
                   for i in range(x.ndim))
     shift = jax.lax.stop_gradient(xs[first])

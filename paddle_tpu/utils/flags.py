@@ -70,6 +70,9 @@ DEFINE_flag("fuse_optimizer", True,
             "stack same-recipe per-parameter update ops into fused_update "
             "ops (fluid/fusion.py) so the compiled step launches a few "
             "fused kernels instead of one per parameter")
+DEFINE_flag("bn_shifted_stats", True,
+            "compute batch-norm statistics in the shifted one-pass form "
+            "(cancellation-safe); 0 = plain E[x^2]-E[x]^2 (perf A/B knob)")
 DEFINE_flag("amp_bf16_act", True,
             "when amp_bf16 is on, keep activations bfloat16 between ops "
             "instead of casting every MXU output back to f32 — halves "

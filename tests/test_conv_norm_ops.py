@@ -261,6 +261,38 @@ class TestBatchNormTrain(OpTest):
                           "SavedVariance"))
 
 
+class TestBatchNormTrainUnshiftedStats(OpTest):
+    """FLAGS_bn_shifted_stats=0 (the perf A/B knob) must compute the
+    same statistics via the plain one-pass form."""
+    op_type = "batch_norm"
+
+    def test(self):
+        from paddle_tpu.utils import flags
+
+        c = 3
+        x = RS.rand(4, c, 3, 3).astype("float32")
+        mu = x.mean(axis=(0, 2, 3))
+        sig2 = x.var(axis=(0, 2, 3))
+        eps = 1e-5
+        ref = (x - mu.reshape(1, c, 1, 1)) / np.sqrt(
+            sig2.reshape(1, c, 1, 1) + eps)
+        self.inputs = {"X": x, "Scale": np.ones(c, "float32"),
+                       "Bias": np.zeros(c, "float32"),
+                       "Mean": np.zeros(c, "float32"),
+                       "Variance": np.ones(c, "float32")}
+        self.attrs = {"is_test": False, "epsilon": eps, "momentum": 0.9}
+        self.outputs = {"Y": ref}
+        prev = flags.get_flag("bn_shifted_stats")
+        flags.set_flag("bn_shifted_stats", False)
+        try:
+            self.check_output(
+                atol=1e-4,
+                no_check_set=("MeanOut", "VarianceOut", "SavedMean",
+                              "SavedVariance"))
+        finally:
+            flags.set_flag("bn_shifted_stats", prev)
+
+
 class TestLayerNorm(OpTest):
     op_type = "layer_norm"
 
