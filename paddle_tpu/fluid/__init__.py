@@ -27,6 +27,8 @@ from . import lr_schedules
 from . import amp
 from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize
+from . import recompute
+from .recompute import recompute_program, RecomputeOptimizer
 from . import profiler
 from .data_feeder import DataFeeder
 from .param_attr import ParamAttr

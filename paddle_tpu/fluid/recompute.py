@@ -1,0 +1,273 @@
+"""Forward rematerialization (activation checkpointing) as an IR pass.
+
+HBM is the scarce resource on TPU: a training program built by
+``append_backward`` keeps every forward activation live until its grad
+op consumes it, so peak memory grows with network depth x batch.  This
+pass trades FLOPs for that memory the way ``jax.checkpoint`` does, but
+at the Program level — the backward here is explicit IR (fluid/
+backward.py), not JAX autodiff, so JAX's own remat cannot see it.
+
+Given user-chosen checkpoint variables, the program is cut into
+segments of recomputable forward ops.  In the backward region, the
+first grad op that reads a segment's intermediate triggers insertion of
+a cloned copy of that segment (outputs renamed ``@RCP<k>``), and every
+later op that read the intermediate is remapped to the clone.  The
+original intermediates then die at the end of the forward pass, and XLA
+frees/reuses their buffers.
+
+Two things make the clone actually rematerialize instead of folding
+back into the original computation:
+
+* its checkpoint inputs pass through a ``recompute_barrier`` op
+  (``lax.optimization_barrier``) so XLA's CSE cannot unify the cloned
+  ops with the originals (same trick ``jax.checkpoint`` uses);
+* the barrier also consumes one incoming *gradient* value of the
+  triggering grad op, giving the clone a true data dependency on the
+  backward front so the scheduler cannot hoist it next to the original
+  forward (which would keep both copies live and save nothing).
+
+Ops that must not run twice are never cloned and their outputs become
+implicit checkpoints: RNG consumers (dropout — a re-drawn mask would
+decouple the forward and backward masks), host/non-jittable ops, and
+control-flow ops carrying sub-blocks.
+
+The reference snapshot has no recompute machinery (its memory lever is
+the reuse transpiler, memory_optimization_transpiler.py); this is the
+TPU-native extension of the same memory/compute trade, alongside
+`fluid.memory_optimize`.
+"""
+
+from ..core.desc import OpDesc, VarDesc, BlockRef
+from ..core.types import GRAD_SUFFIX
+from ..ops import registry as op_registry
+from .backward import EMPTY
+
+__all__ = ["recompute_program", "RecomputeOptimizer"]
+
+_RCP = "@RCP"
+
+
+def _is_recomputable(op_desc):
+    if not op_registry.has_op(op_desc.type):
+        return False
+    info = op_registry.get_op_info(op_desc.type)
+    if not info.jittable or info.uses_rng:
+        return False
+    if any(isinstance(a, BlockRef) for a in op_desc.attrs.values()):
+        return False
+    return op_desc.type not in ("feed", "fetch")
+
+
+def _fwd_outputs(op_desc):
+    """Real forward outputs: skip empties and grad-named vars (the loss
+    grad seed is a fill_constant in the forward slice)."""
+    return [n for n in op_desc.output_names()
+            if n and GRAD_SUFFIX not in n]
+
+
+class _Rewriter:
+    def __init__(self, block, checkpoints):
+        self.block = block
+        bd = block.desc
+        self.ops = bd.ops
+        self.first_grad = next(
+            (i for i, od in enumerate(self.ops)
+             if op_registry.is_grad_op_type(od.type)), None)
+
+        ckpt = set(checkpoints)
+        for name, vd in bd.vars.items():
+            if vd.persistable or vd.is_parameter:
+                ckpt.add(name)
+
+        # split the forward slice into segments of recomputable ops,
+        # cut after each op that produces a user checkpoint
+        self.seg_ops = []          # seg id -> [OpDesc]
+        self.seg_of = {}           # intermediate var -> seg id
+        cur = []
+        produced = set()
+        for od in self.ops[:self.first_grad or 0]:
+            outs = _fwd_outputs(od)
+            produced.update(outs)
+            if not outs:
+                # e.g. the loss-grad seed fill_constant: not a forward
+                # value; cloning it would add a second writer of a live
+                # backward variable
+                continue
+            if not _is_recomputable(od):
+                ckpt.update(outs)
+                continue
+            cur.append(od)
+            if any(n in ckpt for n in outs):
+                self._close_segment(cur, ckpt)
+                cur = []
+        self._close_segment(cur, ckpt)
+        # anything the forward never produced (feeds, startup state)
+        # is a checkpoint by construction of seg_of
+        self.ckpt = ckpt
+        self.materialized = {}     # seg id -> {orig name: renamed}
+        self.n_cloned = 0
+
+    def _close_segment(self, ops, ckpt):
+        if not ops:
+            return
+        sid = len(self.seg_ops)
+        self.seg_ops.append(list(ops))
+        for od in ops:
+            for n in _fwd_outputs(od):
+                if n not in ckpt:
+                    self.seg_of[n] = sid
+
+    def run(self):
+        if self.first_grad is None or not self.seg_of:
+            return 0
+        i = self.first_grad
+        while i < len(self.ops):
+            od = self.ops[i]
+            needed = sorted({n for n in od.input_names()
+                             if n in self.seg_of})
+            if needed:
+                clones = []
+                renames = {}
+                for n in needed:
+                    renames.update(
+                        self._materialize(self.seg_of[n], od, clones))
+                self.ops[i:i] = clones
+                i += len(clones)
+                od.inputs = type(od.inputs)(
+                    (slot, [renames.get(n, n) for n in names])
+                    for slot, names in od.inputs.items())
+            i += 1
+        self.block.sync_with_desc()
+        return self.n_cloned
+
+    def _trigger_of(self, grad_op):
+        """A value on the backward front: an incoming grad of the op
+        that first needs the segment (OG@ slots for grad ops; any
+        grad-named input for grad-accumulation sums etc.).  Never the
+        EMPTY placeholder, and never a forward value — a forward
+        intermediate as trigger would pin the original live across the
+        backward, defeating the pass."""
+        for slot, names in grad_op.inputs.items():
+            if slot.startswith("OG@"):
+                for n in names:
+                    if n and n != EMPTY:
+                        return n
+        for names in grad_op.inputs.values():
+            for n in names:
+                if n and n != EMPTY and GRAD_SUFFIX in n:
+                    return n
+        return None
+
+    def _materialize(self, sid, trigger_op, out_clones):
+        """Append clone ops for segment `sid` (and, recursively, any
+        earlier segment it reads) to `out_clones`; return the rename
+        map."""
+        if sid in self.materialized:
+            return self.materialized[sid]
+        renames = {}
+        self.materialized[sid] = renames
+        suffix = "%s%d" % (_RCP, sid)
+
+        # barrier the checkpoint inputs of the whole segment once:
+        # external reads that are neither another segment's intermediate
+        # (those rematerialize recursively below) nor produced in this
+        # segment
+        own_outs = {n for od in self.seg_ops[sid]
+                    for n in _fwd_outputs(od)}
+        barrier_ins = sorted({
+            n for od in self.seg_ops[sid] for n in od.input_names()
+            if n and n not in self.seg_of and n not in own_outs})
+        for n in barrier_ins:
+            renames[n] = n + suffix + "@IN"
+            self._clone_var(n, renames[n])
+        barrier = OpDesc(
+            "recompute_barrier",
+            {"X": list(barrier_ins),
+             "Trigger": [t for t in [self._trigger_of(trigger_op)] if t]},
+            {"Out": [renames[n] for n in barrier_ins]}, {})
+        out_clones.append(barrier)
+
+        for od in self.seg_ops[sid]:
+            if not any(n in self.seg_of for n in _fwd_outputs(od)):
+                # every output is a checkpoint (the segment's tail op):
+                # the original stays live, a clone would be dead code
+                continue
+            ins = type(od.inputs)()
+            for slot, names in od.inputs.items():
+                mapped = []
+                for n in names:
+                    if n in renames:
+                        mapped.append(renames[n])
+                    elif n in self.seg_of and self.seg_of[n] != sid:
+                        # reads an earlier segment's intermediate:
+                        # rematerialize that one first
+                        sub = self._materialize(self.seg_of[n],
+                                                trigger_op, out_clones)
+                        mapped.append(sub.get(n, n))
+                    else:
+                        mapped.append(n)
+                ins[slot] = mapped
+            outs = type(od.outputs)()
+            for slot, names in od.outputs.items():
+                row = []
+                for n in names:
+                    if n and GRAD_SUFFIX not in n:
+                        renames[n] = n + suffix
+                        self._clone_var(n, renames[n])
+                        row.append(renames[n])
+                    else:
+                        row.append(n)
+                outs[slot] = row
+            out_clones.append(OpDesc(od.type, ins, outs, dict(od.attrs)))
+            self.n_cloned += 1
+        # only intermediate renames leak out; checkpoints keep their
+        # original (live) values for every consumer outside the clone
+        self.materialized[sid] = {
+            n: rn for n, rn in renames.items() if n in self.seg_of}
+        return self.materialized[sid]
+
+    def _clone_var(self, src_name, new_name):
+        bd = self.block.desc
+        if new_name in bd.vars:
+            return
+        src = bd.vars.get(src_name)
+        vd = VarDesc(new_name)
+        if src is not None:
+            vd.type = src.type
+            vd.dtype = src.dtype
+            vd.shape = src.shape
+            vd.lod_level = src.lod_level
+        vd.stop_gradient = True
+        bd.vars[new_name] = vd
+
+
+def recompute_program(program, checkpoints, block=None):
+    """Rewrite a built training program (forward + backward [+ update
+    ops]) so forward segments between ``checkpoints`` are recomputed in
+    the backward region instead of kept live across it.  Returns the
+    number of cloned forward ops (0 = nothing to do).  Global block
+    only; sub-block (while/recurrent) bodies are left intact."""
+    names = [c if isinstance(c, str) else c.name for c in checkpoints]
+    block = block if block is not None else program.global_block()
+    return _Rewriter(block, names).run()
+
+
+class RecomputeOptimizer:
+    """Optimizer wrapper: run the inner optimizer's ``minimize`` and
+    then apply the recompute rewrite (reference has no counterpart; the
+    API shape follows the wrapper convention later Paddle adopted for
+    its RecomputeOptimizer so migration reads the same)."""
+
+    def __init__(self, optimizer, checkpoints):
+        self._inner = optimizer
+        self._checkpoints = list(checkpoints)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, **kwargs):
+        optimize_ops, params_grads = self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set, **kwargs)
+        recompute_program(loss.block.program, self._checkpoints)
+        return optimize_ops, params_grads

@@ -158,6 +158,18 @@ def sum_op(ctx, ins, attrs):
     return {"Out": [acc]}
 
 
+@register_op("recompute_barrier", stop_gradient_op=True)
+def recompute_barrier(ctx, ins, attrs):
+    """Identity on X behind lax.optimization_barrier, so recomputed
+    forward clones (fluid/recompute.py) can't be CSE'd into the
+    originals; the Trigger operand (an incoming backward gradient) makes
+    the clone data-depend on the backward front, so the scheduler can't
+    hoist it next to the original forward."""
+    vals = tuple(ins["X"]) + tuple(ins.get("Trigger", []))
+    out = jax.lax.optimization_barrier(vals)
+    return {"Out": list(out[:len(ins["X"])])}
+
+
 @register_op("scale")
 def scale(ctx, ins, attrs):
     x = _x(ins)
