@@ -194,12 +194,16 @@ def _stale_tpu_record(model, metric, amp_bf16):
     return rec
 
 
-def _tagged(metric):
+def _tagged(metric, recompute_stride=0):
     """BENCH_TAG distinguishes variant runs of one config in the
     persisted store and the emitted metric (e.g. the
-    FLAGS_fuse_optimizer=0 A/B: ...batch128+nofuse)."""
+    FLAGS_fuse_optimizer=0 A/B: ...batch128+nofuse); an ACTIVE
+    recompute rewrite (the effective stride, parsed once in main) tags
+    as +rcp<stride>."""
     tag = os.environ.get("BENCH_TAG", "")
-    return "%s+%s" % (metric, tag) if tag else metric
+    parts = ([tag] if tag else []) + \
+        (["rcp%d" % recompute_stride] if recompute_stride else [])
+    return metric + "".join("+" + p for p in parts)
 
 
 def main():
@@ -220,6 +224,17 @@ def main():
     default_batch = ("16" if mode == "infer"
                      else "16" if model == "transformer" else "128")
     batch = int(os.environ.get("BENCH_BATCH", default_batch))
+    # effective recompute stride: train-only (the rewrite targets the
+    # backward region); parsed once so the metric tag and the rewrite
+    # can never disagree
+    try:
+        rcp = int(os.environ.get("BENCH_RECOMPUTE", "0"))
+    except ValueError:
+        raise SystemExit("BENCH_RECOMPUTE must be an integer stride")
+    if rcp < 0:
+        raise SystemExit("BENCH_RECOMPUTE must be >= 0")
+    if mode != "train":
+        rcp = 0
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS",
                                "10" if mode == "train" else "30"))
@@ -250,7 +265,7 @@ def main():
                                int(os.environ.get("BENCH_D_MODEL", "512")))
         else:
             req_metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
-        req_metric = _tagged(req_metric)
+        req_metric = _tagged(req_metric, rcp)
         stale = _stale_tpu_record(model, req_metric, amp_requested)
         if stale is not None:
             print("bench: accelerator claim failed; re-emitting last "
@@ -360,6 +375,16 @@ def main():
                 model, batch, image_size, class_dim)
             feed_names = ["image", "label"]
 
+    # BENCH_RECOMPUTE=<stride>: rematerialize forward segments in the
+    # backward (fluid/recompute.py) — the HBM lever for big-batch runs
+    if rcp:
+        from paddle_tpu.fluid.recompute import (recompute_program,
+                                                auto_checkpoints)
+        cloned = recompute_program(
+            main_prog, auto_checkpoints(main_prog, every=rcp))
+        print("bench: recompute stride %d cloned %d forward ops"
+              % (rcp, cloned), file=sys.stderr, flush=True)
+
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup, scope=scope)
@@ -402,7 +427,7 @@ def main():
         samples_per_sec * gflop_per_sample / (peak_tflops * 1e3), 4))
     baseline = (spec["baseline"] if mode == "train"
                 else spec.get("infer_baseline"))
-    metric = _tagged(metric)
+    metric = _tagged(metric, rcp)
     record = {
         "metric": metric,
         "value": round(samples_per_sec, 2),

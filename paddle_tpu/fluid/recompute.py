@@ -42,7 +42,7 @@ from ..core.types import GRAD_SUFFIX
 from ..ops import registry as op_registry
 from .backward import EMPTY
 
-__all__ = ["recompute_program", "RecomputeOptimizer"]
+__all__ = ["recompute_program", "RecomputeOptimizer", "auto_checkpoints"]
 
 _RCP = "@RCP"
 
@@ -250,6 +250,29 @@ def recompute_program(program, checkpoints, block=None):
     names = [c if isinstance(c, str) else c.name for c in checkpoints]
     block = block if block is not None else program.global_block()
     return _Rewriter(block, names).run()
+
+
+def auto_checkpoints(program, every=8, block=None):
+    """Heuristic checkpoint picker for models that don't expose natural
+    cut points: every ``every``-th recomputable single-output forward op
+    output becomes a checkpoint.  Good enough for chain-style CNNs
+    (ResNet/VGG benches); hand-picked block outputs remain the better
+    choice when the model builder can provide them."""
+    if every < 1:
+        raise ValueError("auto_checkpoints stride must be >= 1, got %r"
+                         % (every,))
+    block = block if block is not None else program.global_block()
+    picks, seen = [], 0
+    for op in block.desc.ops:
+        if op_registry.is_grad_op_type(op.type):
+            break
+        outs = _fwd_outputs(op)
+        if len(outs) != 1 or not _is_recomputable(op):
+            continue
+        seen += 1
+        if seen % every == 0:
+            picks.append(outs[0])
+    return picks
 
 
 class RecomputeOptimizer:
