@@ -27,6 +27,7 @@ this first so updates can be scattered across parameter servers).
 from collections import OrderedDict
 
 from ..core.desc import OpDesc
+from ..utils import flags
 
 __all__ = ["PER_PARAM_UPDATE_OPS", "FUSED_UPDATE_OP", "fuse_update_ops",
            "unfuse_update_ops"]
@@ -73,17 +74,43 @@ def _recipe_key(block, op):
             str(getattr(grad, "type", "")))
 
 
-def fuse_update_ops(block, ops=None, min_group=2):
+def fuse_update_ops(block, ops=None, min_group=2, max_numel=None):
     """Rewrite groups of same-recipe update ops in ``block`` into
     ``fused_update`` ops.  ``ops`` limits the rewrite to those Operators
     (default: every update op in the block).  Returns the Operators that
     now stand for the requested ops — fused ops plus unfused survivors —
-    in block order."""
+    in block order.
+
+    ``max_numel`` (default FLAGS_fuse_optimizer_max_numel) caps which
+    parameters join a stack: kernel-launch overhead scales with op
+    COUNT, which is dominated by the many tiny tensors (BN scales/
+    biases, fc biases), while the stack's concat/split HBM traffic
+    scales with BYTES, dominated by the few big conv/fc kernels — so
+    fusing only the small ones keeps nearly all the launch win at
+    negligible traffic cost.  0 means no cap."""
+    if max_numel is None:
+        max_numel = flags.get_flag("fuse_optimizer_max_numel")
+
+    def small_enough(op):
+        if not max_numel:
+            return True
+        param = block.var_recursive(op.desc.input("Param")[0])
+        shape = getattr(param, "shape", None)
+        if not shape or any(int(s) < 0 for s in shape):
+            return True
+        numel = 1
+        for s in shape:
+            numel *= int(s)
+        return numel <= max_numel
+
     candidates = [op for op in (block.ops if ops is None else ops)
                   if op.type in PER_PARAM_UPDATE_OPS]
     groups = OrderedDict()
     for op in candidates:
-        groups.setdefault(_recipe_key(block, op), []).append(op)
+        # capped-out ops stay in `candidates` (the returned survivors);
+        # they just never join a stack
+        if small_enough(op):
+            groups.setdefault(_recipe_key(block, op), []).append(op)
 
     fused_descs = []
     for group in groups.values():

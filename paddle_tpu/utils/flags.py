@@ -70,6 +70,11 @@ DEFINE_flag("fuse_optimizer", True,
             "stack same-recipe per-parameter update ops into fused_update "
             "ops (fluid/fusion.py) so the compiled step launches a few "
             "fused kernels instead of one per parameter")
+DEFINE_flag("fuse_optimizer_max_numel", 1 << 18,
+            "only parameters this small (elements) join a fused_update "
+            "stack; launch overhead is dominated by the many tiny "
+            "tensors while concat/split HBM traffic is dominated by the "
+            "few big ones.  0 = stack everything")
 DEFINE_flag("bn_shifted_stats", True,
             "compute batch-norm statistics in the shifted one-pass form "
             "(cancellation-safe); 0 = plain E[x^2]-E[x]^2 (perf A/B knob)")

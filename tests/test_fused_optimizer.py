@@ -204,3 +204,37 @@ def test_fused_op_survives_desc_round_trip():
     back = ProgramDesc.from_dict(d)
     fused = [od for od in back.block(0).ops if od.type == "fused_update"]
     assert fused and fused[0].attrs["inner_type"] == "sgd"
+
+
+def test_size_cap_keeps_big_params_unfused():
+    """FLAGS_fuse_optimizer_max_numel: tiny tensors stack, the big
+    matmul kernel keeps its own per-parameter op (launch overhead is
+    about count; concat/split HBM traffic is about bytes)."""
+    from paddle_tpu.utils import flags as flags_mod
+
+    prev = flags_mod.get_flag("fuse_optimizer_max_numel")
+    flags_mod.set_flag("fuse_optimizer_max_numel", 1000)
+    try:
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+            t = fluid.layers.fc(input=x, size=64)     # 64x64 > cap
+            t = fluid.layers.fc(input=t, size=8)      # 64x8 + biases < cap
+            loss = fluid.layers.mean(x=t)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ops = [op for op in main.global_block().ops
+               if op.type in ("sgd", "fused_update")]
+        by_type = {}
+        for op in ops:
+            by_type.setdefault(op.type, []).append(op)
+        # the 64x64 weight stays per-param; the small ones stack
+        assert len(by_type["sgd"]) == 1
+        big = by_type["sgd"][0].desc.input("Param")[0]
+        blk = main.global_block()
+        shape = blk.var_recursive(big).shape
+        assert int(shape[0]) * int(shape[1]) > 1000
+        assert len(by_type["fused_update"]) == 1
+        assert len(by_type["fused_update"][0].desc.input("Param")) == 3
+    finally:
+        flags_mod.set_flag("fuse_optimizer_max_numel", prev)
