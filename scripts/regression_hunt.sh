@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Regression hunt for the round-5 ResNet-50 b128 number (1182.7 img/s
+# vs round-3's 1863): one bench at a time, same patient-claim
+# discipline as tpu_watch.sh.  Run ONLY when no other TPU process is
+# active (pgrep -f 'python bench.py' must be empty) — a second claim
+# wedges the grant (docs/PERF.md round-5 notes; memory: every python
+# process with the default PYTHONPATH claims the chip at interpreter
+# start, so helpers must run with PYTHONPATH= JAX_PLATFORMS=cpu).
+#
+# Matrix (each persists to BENCH_LAST_TPU.json under its own key):
+#   1. nofuse      — isolates the optimizer fusion (also in tpu_watch)
+#   2. bn-unshift  — isolates the shifted BN statistics form
+#   3. smallfuse   — the size-capped stack (current default, post-fix)
+#   4. rcp8-b256   — recompute retry of the OOM/wedge-suspect batch 256
+# Control for "environment changed": check out the round-3 tree
+# (git worktree add /tmp/r3tree 843b3d9) and run its bench.py verbatim;
+# ~1863 img/s there = code regression here, ~1180 = environment.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+log="docs/regression_hunt.log"
+
+say() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$log"; }
+
+if pgrep -f 'python bench.py' >/dev/null; then
+  say "another bench is running — refusing to contend"; exit 1
+fi
+
+run_one() {  # run_one <label> [ENV=VAL ...]
+  local label="$1"; shift
+  say "hunt $label ..."
+  if env BENCH_CLAIM_TIMEOUT=0 "$@" timeout 2400 python bench.py \
+      >>"$log" 2>&1; then
+    say "hunt $label OK: $(grep -o '{.*}' "$log" | tail -1)"
+  else
+    say "hunt $label FAILED (rc=$?)"
+  fi
+}
+
+run_one nofuse BENCH_TAG=nofuse FLAGS_fuse_optimizer=0
+run_one bn-unshift BENCH_TAG=bnunshift FLAGS_bn_shifted_stats=0
+run_one smallfuse BENCH_TAG=smallfuse
+run_one rcp8-b256 BENCH_BATCH=256 BENCH_RECOMPUTE=8
+say "done — compare records in BENCH_LAST_TPU.json"
