@@ -28,37 +28,32 @@ import time
 
 import numpy as np
 
-# ResNet-50 training cost model: ~4.1 GFLOP forward per 224x224 image,
-# x3 for forward + backward (dgrad + wgrad) = ~12.3 GFLOP/img.  Other
-# entries use the same x3 rule on the models' published forward FLOPs.
+# Image-model FLOPs are computed exactly from the built program IR
+# (fluid/analysis.py program_costs — matches XLA's per-HLO FLOP
+# accounting); lstm/transformer use closed-form per-run models below.
 # Baselines: BASELINE.md (IntelOptimizedPaddle.md CPU img/s tables and
 # benchmark/README.md K40m ms/batch converted to img/s at batch 128).
 _MODELS = {
     # infer_baseline: reference MKL-DNN inference img/s at batch 16
     # (/root/reference/benchmark/IntelOptimizedPaddle.md:68-104); vgg16
     # has no published row (the reference measured vgg19)
-    "resnet50": dict(baseline=82.35, gflop=12.3, unit="img/s",
+    "resnet50": dict(baseline=82.35, unit="img/s",
                      infer_baseline=217.69),
-    "alexnet": dict(baseline=498.94, gflop=2.1, unit="img/s",
+    "alexnet": dict(baseline=498.94, unit="img/s",
                     infer_baseline=850.51),
-    "vgg16": dict(baseline=29.83, gflop=46.5, unit="img/s",
-                  infer_baseline=None),
-    "vgg19": dict(baseline=29.83, gflop=59.0, unit="img/s",
-                  infer_baseline=96.75),
-    "googlenet": dict(baseline=264.83, gflop=4.8, unit="img/s",
+    "vgg16": dict(baseline=29.83, unit="img/s", infer_baseline=None),
+    "vgg19": dict(baseline=29.83, unit="img/s", infer_baseline=96.75),
+    "googlenet": dict(baseline=264.83, unit="img/s",
                       infer_baseline=600.94),
-    "smallnet": dict(baseline=7039.0, gflop=0.04, unit="img/s",
-                     infer_baseline=None),
+    "smallnet": dict(baseline=7039.0, unit="img/s", infer_baseline=None),
     # strongest published LSTM number: batch 256, hidden 256 on
     # K40m = 170 ms/batch -> 1506 samples/s (BASELINE.md:26);
-    # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256.
-    # gflop computed per-run from seq_len/hidden, not a constant
-    "lstm": dict(baseline=1506.0, gflop=None, unit="samples/s"),
+    # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256
+    "lstm": dict(baseline=1506.0, unit="samples/s"),
     # no reference counterpart (the 2018 snapshot has no transformer):
     # exercises the pallas flash-attention op through the Program
-    # stack; vs_baseline is null by design.  gflop per token computed
-    # per-run from the config.
-    "transformer": dict(baseline=None, gflop=None, unit="tokens/s"),
+    # stack; vs_baseline is null by design
+    "transformer": dict(baseline=None, unit="tokens/s"),
 }
 
 # MFU denominator: TPU v5e peak (matches the chip the driver benches
@@ -309,6 +304,7 @@ def main():
                                                    dict_dim, hidden)
         feed_names = ["words", "label"]
         feeds_np = _lstm_feeds(batch, seq_len, dict_dim)
+        flops_model = "closed-form"
         metric = "lstm_train_samples_per_sec_batch%d_hidden%d" \
             % (batch, hidden)
         # stacked-lstm matmul FLOPs per sample: fc1 (emb128->4H) +
@@ -333,6 +329,7 @@ def main():
                 learning_rate=0.01, momentum=0.9).minimize(avg_loss)
         feed_names = ["tokens", "positions", "targets"]
         feeds_np = transformer_program_feeds(batch, seq_len, vocab)
+        flops_model = "closed-form"
         metric = "transformer_train_tokens_per_sec_batch%d_seq%d_d%d" \
             % (batch, seq_len, d_model)
         # per token, fwd+bwd (x3): ~12*L*d^2 matmul MACs x2, the causal
@@ -348,9 +345,6 @@ def main():
             "BENCH_IMAGE_SIZE", "32" if model == "smallnet" else "224"))
         class_dim = int(os.environ.get(
             "BENCH_CLASS_DIM", "10" if model == "smallnet" else "1000"))
-        # scale the FLOPs model when smoke runs at a tiny image size
-        ref_size = 32.0 if model == "smallnet" else 224.0
-        gflop_per_sample = spec["gflop"] * (image_size / ref_size) ** 2
         metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
         feeds_np = _image_feeds(batch, image_size, class_dim)
         if mode == "infer":
@@ -368,12 +362,20 @@ def main():
             avg_loss = logits
             feed_names = ["image"]
             feeds_np = {"image": feeds_np["image"]}
-            # spec gflop is fwd+bwd (x3 rule); inference is forward only
-            gflop_per_sample /= 3
         else:
             main_prog, startup, _, avg_loss = _build_image_model(
                 model, batch, image_size, class_dim)
             feed_names = ["image", "label"]
+        # exact FLOPs from the built IR (fluid/analysis.py) rather than
+        # a hand-maintained constant: fwd-only for the inference clone,
+        # fwd+dgrad+wgrad for training, any image size — and the count
+        # matches XLA's own per-HLO accounting, so `mfu` here reads
+        # against the profile tables directly
+        from paddle_tpu.fluid.analysis import program_costs
+
+        step_flops = sum(f for _, f, _, _ in program_costs(main_prog))
+        gflop_per_sample = step_flops / 1e9 / batch
+        flops_model = "ir-2flops-per-mac"
 
     # BENCH_RECOMPUTE=<stride>: rematerialize forward segments in the
     # backward (fluid/recompute.py) — the HBM lever for big-batch runs
@@ -436,6 +438,10 @@ def main():
                         else round(samples_per_sec / baseline, 3)),
         "step_ms": round(step_ms, 2),
         "mfu": mfu,
+        # which FLOP accounting `mfu` uses: records without this field
+        # predate the exact IR count (their image-model mfu runs ~2x
+        # low — the old constants were MAC counts)
+        "flops_model": None if mfu is None else flops_model,
         "amp_bf16": amp_bf16,
         # the platform JAX actually ran on, not the requested one
         "platform": dev.platform + ("-fallback" if fallback else ""),
