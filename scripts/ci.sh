@@ -7,6 +7,11 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+# the axon sitecustomize on the default PYTHONPATH performs the TPU
+# claim handshake at interpreter start of EVERY python process — even
+# JAX_PLATFORMS=cpu ones.  CI must never contend with the bench
+# watcher for the chip, so drop it entirely.
+export PYTHONPATH=
 
 echo "[ci] compile check (syntax across the tree) ..."
 python -m compileall -q paddle_tpu tests examples bench.py \
