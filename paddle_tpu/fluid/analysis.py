@@ -17,8 +17,8 @@ analyzer is a first-class framework facility.
 Model caveats (documented, deliberate):
   * bytes are per-op (every input read + output written once).  XLA
     fuses elementwise chains, so the true traffic sits between the
-    per-op sum and the optimistic bound where intermediates are free;
-    both are reported.
+    per-op sum and the unique-bytes bound where each distinct tensor
+    moves through HBM exactly once; both are reported.
   * with ``bf16_act`` (the FLAGS_amp_bf16_act policy), non-persistable
     float tensors count 2 bytes/element; persistable (master weights,
     running stats) stay 4.
@@ -121,13 +121,17 @@ def op_cost(block, od, bf16_act=False):
     total_bytes = 0
     for names in list(od.inputs.values()) + list(od.outputs.values()):
         for n in names:
-            meta = _var_meta(block, n)
-            if not meta or meta[0] is None:
-                continue
-            v = block.var_recursive(n)
-            total_bytes += _numel(meta[0]) * _elem_bytes(
-                meta[1], bool(getattr(v, "persistable", False)), bf16_act)
+            total_bytes += _tensor_bytes(block, n, bf16_act)
     return flops, total_bytes, klass
+
+
+def _tensor_bytes(block, name, bf16_act):
+    meta = _var_meta(block, name)
+    if not meta or meta[0] is None:
+        return 0
+    v = block.var_recursive(name)
+    return _numel(meta[0]) * _elem_bytes(
+        meta[1], bool(getattr(v, "persistable", False)), bf16_act)
 
 
 def program_costs(program, bf16_act=False, block=None):
@@ -138,6 +142,22 @@ def program_costs(program, bf16_act=False, block=None):
             for od in block.desc.ops]
 
 
+def _unique_bytes(block, bf16_act):
+    """Bytes if every referenced tensor moved exactly once — the
+    perfect-fusion traffic floor (intermediates inside a fusion are
+    free, but each distinct value is produced/consumed through HBM at
+    least once)."""
+    seen = set()
+    total = 0
+    for od in block.desc.ops:
+        for names in list(od.inputs.values()) + list(od.outputs.values()):
+            for n in names:
+                if n not in seen:
+                    seen.add(n)
+                    total += _tensor_bytes(block, n, bf16_act)
+    return total
+
+
 def roofline_report(program, peak_tflops=DEFAULT_PEAK_TFLOPS,
                     hbm_gbps=DEFAULT_HBM_GBPS, bf16_act=False,
                     block=None):
@@ -146,13 +166,15 @@ def roofline_report(program, peak_tflops=DEFAULT_PEAK_TFLOPS,
       * ``floor_ms_serial`` — sum over ops of max(t_mxu, t_hbm): every
         op runs alone, no fusion (pessimistic traffic, realistic
         serialization);
-      * ``floor_ms_ideal`` — max(total FLOPs / peak, total bytes / bw)
-        as if the whole step were one perfectly overlapped kernel.
+      * ``floor_ms_ideal`` — max(total FLOPs / peak, unique bytes /
+        bw): perfect fusion (each distinct tensor moves once) and
+        perfect compute/memory overlap.
     The measured step time should land between them; distance from
     ``floor_ms_serial`` is fusion/overlap win, distance of
     ``floor_ms_serial`` from ``floor_ms_ideal`` is the remaining
     fusion headroom."""
-    rows = program_costs(program, bf16_act=bf16_act, block=block)
+    block_ = block if block is not None else program.global_block()
+    rows = program_costs(program, bf16_act=bf16_act, block=block_)
     peak = peak_tflops * 1e12
     bw = hbm_gbps * 1e9
     agg = defaultdict(lambda: [0, 0, 0, 0.0])  # count, flops, bytes, t
@@ -169,14 +191,16 @@ def roofline_report(program, peak_tflops=DEFAULT_PEAK_TFLOPS,
         t_serial += t
         tot_flops += flops
         tot_bytes += nbytes
+    uniq = _unique_bytes(block_, bf16_act)
     return {
         "per_type": {k: {"count": v[0], "gflops": v[1] / 1e9,
                          "mbytes": v[2] / 1e6, "t_ms": v[3] * 1e3}
                      for k, v in agg.items()},
         "total_gflops": tot_flops / 1e9,
         "total_gbytes": tot_bytes / 1e9,
+        "unique_gbytes": uniq / 1e9,
         "floor_ms_serial": t_serial * 1e3,
-        "floor_ms_ideal": max(tot_flops / peak, tot_bytes / bw) * 1e3,
+        "floor_ms_ideal": max(tot_flops / peak, uniq / bw) * 1e3,
         "peak_tflops": peak_tflops,
         "hbm_gbps": hbm_gbps,
         "bf16_act": bf16_act,
@@ -200,11 +224,11 @@ def format_report(report, topk=12):
             sum(v["mbytes"] for _, v in rest),
             sum(v["t_ms"] for _, v in rest)))
     lines.append("")
-    lines.append("total %.1f GFLOP, %.2f GB moved  (peak %.0f TFLOP/s, "
-                 "%.0f GB/s, bf16_act=%s)"
+    lines.append("total %.1f GFLOP, %.2f GB per-op / %.2f GB unique  "
+                 "(peak %.0f TFLOP/s, %.0f GB/s, bf16_act=%s)"
                  % (report["total_gflops"], report["total_gbytes"],
-                    report["peak_tflops"], report["hbm_gbps"],
-                    report["bf16_act"]))
+                    report["unique_gbytes"], report["peak_tflops"],
+                    report["hbm_gbps"], report["bf16_act"]))
     lines.append("step floor: %.2f ms serial-per-op  |  %.2f ms "
                  "perfectly-fused" % (report["floor_ms_serial"],
                                       report["floor_ms_ideal"]))
