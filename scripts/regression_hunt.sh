@@ -36,7 +36,14 @@ run_one() {  # run_one <label> [ENV=VAL ...]
   fi
 }
 
+# r3config reproduces the exact round-3 1863 img/s configuration
+# (f32 activations, unfused updates, two-pass BN): ~1863 there means
+# the environment is unchanged and the delta is in one of the three
+# code changes; ~1180 means the chip/tunnel itself got slower.
+run_one r3config BENCH_TAG=r3config FLAGS_amp_bf16_act=0 \
+    FLAGS_fuse_optimizer=0 FLAGS_bn_shifted_stats=0
 run_one nofuse BENCH_TAG=nofuse FLAGS_fuse_optimizer=0
+run_one f32act BENCH_TAG=f32act FLAGS_amp_bf16_act=0
 run_one bn-unshift BENCH_TAG=bnunshift FLAGS_bn_shifted_stats=0
 run_one smallfuse BENCH_TAG=smallfuse
 run_one rcp8-b256 BENCH_BATCH=256 BENCH_RECOMPUTE=8
