@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# Phase-2 regression hunt for the b128 ResNet-50 delta (r3config
+# measured 2016.55 img/s vs the round-5 default's 1182.7 — one of
+# {bf16 activations, optimizer fusion, shifted BN stats} is a ~1.7x
+# regression on the real chip).  Differences from phase 1:
+#
+#   * COMPILE-HEALTH PROBE GATE: the wedge failure mode is the remote
+#     compile service (127.0.0.1:<port>/remote_compile) blocking ~27
+#     min then EOF — claims stay instant throughout.  A tiny-jit probe
+#     with a 120 s timeout detects a healthy compile path for a few
+#     seconds instead of discovering a wedge 27 minutes into a real
+#     bench; legs only launch behind a passing probe.
+#   * persistent XLA compilation cache (.jax_cache): if the serialized
+#     executable round-trips, a config that ever compiled skips the
+#     wedge-prone step on re-run.
+#   * failed legs retry in later sweeps instead of being lost.
+#
+# Factor key: act(bf16/f32) x fuse(full/capped/off) x bn(shift/unshift)
+#   default  = (bf16, capped, shift)   -> the round's headline config
+#   r3config = (f32,  off,    unshift) -> 2016.55 measured (phase 1)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+log="docs/regression_hunt2.log"
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+start_epoch="$(date +%s)"
+
+say() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$log"; }
+
+compile_healthy() {  # tiny end-to-end jit through the remote compiler
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+print(jax.jit(lambda x: x * 2 + 1)(jnp.arange(8.0))[3])" \
+    >>"$log" 2>&1
+}
+
+captured() {  # captured <record-key> — measured since this hunt began
+  PYTHONPATH= JAX_PLATFORMS=cpu python - "$1" "$start_epoch" <<'PY'
+import json, sys
+try:
+    store = json.load(open("BENCH_LAST_TPU.json"))
+except Exception:
+    sys.exit(1)
+rec = store.get(sys.argv[1])
+sys.exit(0 if rec and rec.get("measured_at", 0) >= float(sys.argv[2]) - 3600
+          else 1)
+PY
+}
+
+run_one() {  # run_one <label> <record-key> [ENV=VAL ...]
+  local label="$1" key="$2"; shift 2
+  captured "$key" && { say "$label already captured — skipping"; return 0; }
+  until compile_healthy; do
+    say "compile path wedged; probe again in 300s (pending: $label)"
+    sleep 300
+  done
+  say "$label (probe healthy) ..."
+  local t0=$(date +%s)
+  if env BENCH_CLAIM_TIMEOUT=0 "$@" timeout 2400 python bench.py \
+      >>"$log" 2>&1; then
+    say "$label OK in $(( $(date +%s) - t0 ))s: $(grep -o '{.*}' "$log" | tail -1)"
+    return 0
+  fi
+  say "$label FAILED (rc=$?) after $(( $(date +%s) - t0 ))s"
+  return 1
+}
+
+for sweep in 1 2 3 4 5 6; do
+  say "=== sweep $sweep ==="
+  pending=0
+  run_one f32act "resnet50_train_imgs_per_sec_batch128+f32act|bf16" \
+    BENCH_TAG=f32act FLAGS_amp_bf16_act=0 || pending=1
+  run_one nofuse "resnet50_train_imgs_per_sec_batch128+nofuse|bf16" \
+    BENCH_TAG=nofuse FLAGS_fuse_optimizer=0 || pending=1
+  run_one bnunshift "resnet50_train_imgs_per_sec_batch128+bnunshift|bf16" \
+    BENCH_TAG=bnunshift FLAGS_bn_shifted_stats=0 || pending=1
+  run_one smallfuse "resnet50_train_imgs_per_sec_batch128+smallfuse|bf16" \
+    BENCH_TAG=smallfuse || pending=1
+  run_one r3b256 "resnet50_train_imgs_per_sec_batch256+r3b256|bf16" \
+    BENCH_TAG=r3b256 BENCH_BATCH=256 FLAGS_amp_bf16_act=0 \
+    FLAGS_fuse_optimizer=0 FLAGS_bn_shifted_stats=0 || pending=1
+  [ "$pending" = 0 ] && { say "all legs captured"; break; }
+  say "sweep $sweep incomplete; sleeping 600"
+  sleep 600
+done
+say "done — records in BENCH_LAST_TPU.json"
