@@ -234,6 +234,23 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS",
                                "10" if mode == "train" else "30"))
 
+    # Persistent XLA compilation cache: on this setup the remote
+    # compile service is the wedge-prone step (blocks ~27 min then
+    # EOF while claims stay instant), so an executable cached from an
+    # earlier healthy compile makes the same config immune to later
+    # wedges.  Accelerator runs only — a CPU AOT entry compiled
+    # elsewhere can load with mismatched machine features (observed:
+    # cpu_aot_loader SIGILL warning), and the CPU fallback must never
+    # risk that.  Opt out with BENCH_COMPILE_CACHE=0.
+    if (os.environ.get("BENCH_COMPILE_CACHE", "1") != "0"
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
+        os.environ.setdefault(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
     import jax
 
     # the axon sitecustomize force-selects the TPU platform at
