@@ -197,7 +197,8 @@ def _tagged(metric, recompute_stride=0):
     as +rcp<stride>."""
     tag = os.environ.get("BENCH_TAG", "")
     parts = ([tag] if tag else []) + \
-        (["rcp%d" % recompute_stride] if recompute_stride else [])
+        (["rcp%d" % recompute_stride] if recompute_stride else []) + \
+        (["nhwc"] if os.environ.get("BENCH_LAYOUT") == "NHWC" else [])
     return metric + "".join("+" + p for p in parts)
 
 

@@ -29,6 +29,8 @@ from . import memory_optimization_transpiler
 from .memory_optimization_transpiler import memory_optimize
 from . import recompute
 from .recompute import recompute_program, RecomputeOptimizer
+from . import data_transform
+from .data_transform import convert_layout
 from . import profiler
 from .data_feeder import DataFeeder
 from .param_attr import ParamAttr

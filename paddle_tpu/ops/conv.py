@@ -20,6 +20,19 @@ from .amp_util import mxu_operands, conv_acc_kwargs, amp_result
 from ..core.ragged import RaggedTensor
 
 
+def _layout4d(attrs):
+    """(dimension-number string, spatial dim indices) for a 4-D image
+    op.  Weights stay OIHW in both layouts — lax dimension numbers
+    absorb the difference, so NHWC execution needs no parameter
+    relayout (checkpoints are layout-portable)."""
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NHWC":
+        return "NHWC", (1, 2)
+    if layout == "NCHW":
+        return "NCHW", (2, 3)
+    raise ValueError("unsupported data_layout %r" % (layout,))
+
+
 @register_op("conv2d")
 def conv2d(ctx, ins, attrs):
     x = ins["Input"][0]
@@ -28,24 +41,25 @@ def conv2d(ctx, ins, attrs):
     paddings = tuple(attrs.get("paddings", [0, 0]))
     dilations = tuple(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1) or 1)
+    dn, sdims = _layout4d(attrs)
     xm, wm = mxu_operands(x, w)
     out = lax.conv_general_dilated(
         xm, wm, window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(dn, "OIHW", dn),
         **conv_acc_kwargs(xm, wm))
-    _check_spatial(out, "conv2d", x)
+    _check_spatial(out, "conv2d", x, sdims)
     return {"Output": [amp_result(out, x.dtype)]}
 
 
-def _check_spatial(out, opname, x):
+def _check_spatial(out, opname, x, sdims=(2, 3)):
     """A kernel/stride combination larger than the input silently
     yields a zero-sized spatial dim and a baffling error far
     downstream (e.g. a reshape ZeroDivision in the first fc) — fail
-    HERE with the shapes instead.  Only the spatial dims (2:) are
-    checked: an empty batch or channel dim is the caller's business."""
-    if 0 in out.shape[2:]:
+    HERE with the shapes instead.  Only the spatial dims are checked:
+    an empty batch or channel dim is the caller's business."""
+    if any(out.shape[d] == 0 for d in sdims if d < len(out.shape)):
         raise ValueError(
             "%s produced an empty output %s from input %s — the input "
             "spatial size is too small for this kernel/stride/padding"
@@ -85,6 +99,7 @@ def conv2d_transpose(ctx, ins, attrs):
     # conv backward-data path)
     kh = (w.shape[2] - 1) * dilations[0] + 1
     kw = (w.shape[3] - 1) * dilations[1] + 1
+    dn, sdims = _layout4d(attrs)
     xm, wm = mxu_operands(x, jnp.flip(jnp.swapaxes(w, 0, 1), (2, 3)))
     out = lax.conv_general_dilated(
         xm, wm,
@@ -93,9 +108,9 @@ def conv2d_transpose(ctx, ins, attrs):
                  (kw - 1 - paddings[1], kw - 1 - paddings[1])],
         lhs_dilation=strides,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(dn, "OIHW", dn),
         **conv_acc_kwargs(xm, wm))
-    _check_spatial(out, "conv2d_transpose", x)
+    _check_spatial(out, "conv2d_transpose", x, sdims)
     return {"Output": [amp_result(out, x.dtype)]}
 
 
@@ -129,14 +144,22 @@ def _pool2d_impl(x, attrs):
     ksize = list(attrs.get("ksize", [2, 2]))
     strides = list(attrs.get("strides", [1, 1]))
     paddings = list(attrs.get("paddings", [0, 0]))
+    _, sdims = _layout4d(attrs)
+    sh, sw = sdims
     if attrs.get("global_pooling", False):
-        ksize = [x.shape[2], x.shape[3]]
+        ksize = [x.shape[sh], x.shape[sw]]
         strides = [1, 1]
         paddings = [0, 0]
-    window = (1, 1, ksize[0], ksize[1])
-    strides4 = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
-            (paddings[1], paddings[1]))
+
+    def per_dim(spatial_pair, rest):
+        dims = [rest, rest, rest, rest]
+        dims[sh], dims[sw] = spatial_pair
+        return tuple(dims)
+
+    window = per_dim((ksize[0], ksize[1]), 1)
+    strides4 = per_dim((strides[0], strides[1]), 1)
+    pads = per_dim(((paddings[0], paddings[0]),
+                    (paddings[1], paddings[1])), (0, 0))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -148,11 +171,13 @@ def _pool2d_impl(x, attrs):
             # compute them on host so XLA doesn't constant-fold a full
             # reduce-window over a ones tensor at compile time
             counts = _np_pool_counts(
-                (x.shape[2], x.shape[3]), ksize, strides, paddings)
-            out = summed / jnp.asarray(counts, summed.dtype)[None, None]
+                (x.shape[sh], x.shape[sw]), ksize, strides, paddings)
+            cshape = [1, 1, 1, 1]
+            cshape[sh], cshape[sw] = counts.shape
+            out = summed / jnp.asarray(counts, summed.dtype).reshape(cshape)
         else:
             out = summed / (ksize[0] * ksize[1])
-    return _check_spatial(out, "pool2d", x)
+    return _check_spatial(out, "pool2d", x, sdims)
 
 
 def _np_pool_counts(hw, ksize, strides, paddings):
