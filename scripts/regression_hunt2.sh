@@ -84,4 +84,27 @@ for sweep in 1 2 3 4 5 6; do
   say "sweep $sweep incomplete; sleeping 600"
   sleep 600
 done
+
+# Per-HLO profiles of the two ends of the factor space: the category
+# deltas (copy/convert/fusion times) are the diagnosis for WHY the
+# default config regressed.  Same program as the bench legs, so the
+# persistent cache (if the axon backend honors it) makes these cheap.
+profile_one() {  # profile_one <outfile> [ENV=VAL ...]
+  local out="$1"; shift
+  [ -s "$out" ] && { say "profile $out exists — skipping"; return 0; }
+  until compile_healthy; do
+    say "compile path wedged; probe again in 300s (pending: $out)"
+    sleep 300
+  done
+  say "profiling -> $out"
+  if env PROFILE_STEPS=10 "$@" timeout 2400 python scripts/profile_tpu.py \
+      >"$out" 2>&1; then
+    say "profile $out OK"
+  else
+    say "profile $out FAILED (rc=$?)"; return 1
+  fi
+}
+profile_one docs/profile_r5_default.txt
+profile_one docs/profile_r5_r3config.txt FLAGS_amp_bf16_act=0 \
+  FLAGS_fuse_optimizer=0 FLAGS_bn_shifted_stats=0
 say "done — records in BENCH_LAST_TPU.json"
