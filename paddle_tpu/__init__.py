@@ -15,9 +15,10 @@ from .reader.decorator import batch
 __version__ = "0.1.0"
 
 __all__ = ["reader", "dataset", "batch", "fluid", "v2", "infer",
-           "layer"]
+           "layer", "image"]
 
 from . import fluid  # noqa: E402
 from . import v2  # noqa: E402
 from .v2 import layer  # noqa: E402
+from .v2 import image  # noqa: E402
 from .v2.inference import infer  # noqa: E402

@@ -15,6 +15,7 @@ from . import attr
 from . import data_type
 from . import evaluator
 from . import event
+from . import image
 from . import inference
 from . import layer
 from . import networks

@@ -1,0 +1,91 @@
+"""paddle.v2.image preprocessing (reference: python/paddle/v2/image.py)."""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def rgb():
+    rs = np.random.RandomState(7)
+    return rs.randint(0, 255, size=(40, 60, 3), dtype=np.uint8)
+
+
+def test_load_roundtrip(rgb, tmp_path):
+    p = tmp_path / "im.png"
+    p.write_bytes(_png_bytes(rgb))
+    im = paddle.image.load_image(str(p))
+    np.testing.assert_array_equal(im, rgb)           # PNG is lossless
+    gray = paddle.image.load_image(str(p), is_color=False)
+    assert gray.shape == (40, 60)
+    np.testing.assert_array_equal(
+        paddle.image.load_image_bytes(p.read_bytes()), rgb)
+
+
+def test_resize_short_preserves_aspect(rgb):
+    out = paddle.image.resize_short(rgb, 20)        # h<w: h becomes 20
+    assert out.shape == (20, 30, 3)
+    tall = paddle.image.resize_short(rgb.transpose(1, 0, 2), 20)
+    assert tall.shape == (30, 20, 3)
+
+
+def test_crops_and_flip(rgb):
+    c = paddle.image.center_crop(rgb, 24)
+    assert c.shape == (24, 24, 3)
+    np.testing.assert_array_equal(c, rgb[8:32, 18:42])
+    r = paddle.image.random_crop(rgb, 24)
+    assert r.shape == (24, 24, 3)
+    np.testing.assert_array_equal(
+        paddle.image.left_right_flip(rgb)[:, ::-1], rgb)
+    chw = paddle.image.to_chw(rgb)
+    assert chw.shape == (3, 40, 60)
+
+
+def test_simple_transform_eval_and_train(rgb):
+    mean = [127.5, 127.5, 127.5]
+    out = paddle.image.simple_transform(rgb, 32, 24, is_train=False,
+                                        mean=mean)
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    assert out.min() >= -128 and out.max() <= 128
+    tr = paddle.image.simple_transform(rgb, 32, 24, is_train=True)
+    assert tr.shape == (3, 24, 24)
+
+
+def test_batch_images_from_tar(tmp_path):
+    rs = np.random.RandomState(0)
+    tar_path = str(tmp_path / "imgs.tar")
+    img2label = {}
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(5):
+            raw = _png_bytes(rs.randint(0, 255, size=(8, 8, 3),
+                                        dtype=np.uint8))
+            name = "img_%d.png" % i
+            info = tarfile.TarInfo(name)
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+            if i != 3:                # one unlabeled image is skipped
+                img2label[name] = i
+    meta = paddle.image.batch_images_from_tar(tar_path, "train",
+                                              img2label, num_per_batch=2)
+    shards = open(meta).read().splitlines()
+    assert len(shards) == 2           # 4 labeled images, 2 per shard
+    total, labels = 0, []
+    for s in shards:
+        z = np.load(s, allow_pickle=True)
+        total += len(z["data"])
+        labels += list(z["labels"])
+        decoded = paddle.image.load_image_bytes(z["data"][0].tobytes())
+        assert decoded.shape == (8, 8, 3)
+    assert total == 4 and 3 not in labels
