@@ -1,0 +1,75 @@
+"""Single-file model packaging (reference: paddle/utils/merge_model.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.utils.merge_model import (load_merged_model,
+                                          merge_inference_model,
+                                          merge_v2_model)
+
+
+def _feeds(n=6):
+    rs = np.random.RandomState(3)
+    return {"x": rs.rand(n, 13).astype(np.float32)}
+
+
+def test_merge_inference_dir_roundtrip(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4, act="tanh")
+        out = fluid.layers.fc(input=y, size=1, act=None)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    want, = exe.run(main, feed=_feeds(), fetch_list=[out], scope=scope)
+
+    model_dir = str(tmp_path / "model")
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main)
+    merged = merge_inference_model(model_dir, str(tmp_path / "one.tar"))
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = load_merged_model(merged, exe,
+                                                 scope=scope2)
+        assert feeds == ["x"]
+        got, = exe.run(prog, feed=_feeds(), fetch_list=fetches,
+                       scope=scope2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
+
+
+def test_merge_v2_model(tmp_path):
+    import paddle_tpu.v2 as v2
+
+    v2.init(use_gpu=False)
+    x = v2.layer.data(name="x", type=v2.data_type.dense_vector(13))
+    hidden = v2.layer.fc(input=x, size=4, act=v2.activation.Tanh())
+    out = v2.layer.fc(input=hidden, size=1,
+                      act=v2.activation.Linear())
+    params = v2.parameters.create(out)
+
+    param_file = str(tmp_path / "params.tar")
+    with open(param_file, "wb") as f:
+        params.to_tar(f)
+
+    merged = merge_v2_model(out, param_file,
+                            str(tmp_path / "deploy.tar"))
+
+    # the merged file alone reproduces the v2 inference result
+    feed = _feeds()
+    want = paddle.infer(output_layer=out, parameters=params,
+                        input=[(row,) for row in feed["x"]])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        prog, feeds, fetches = load_merged_model(merged, exe,
+                                                 scope=scope)
+        got, = exe.run(prog, feed={feeds[0]: feed["x"]},
+                       fetch_list=fetches, scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
