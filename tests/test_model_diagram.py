@@ -1,0 +1,49 @@
+"""Program visualizer (reference: paddle/utils/make_model_diagram.py,
+show_pb.py)."""
+
+import json
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.utils.model_diagram import (main, program_to_dot,
+                                            program_to_text)
+
+
+def _program():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        label = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=h, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main_p
+
+
+def test_dot_structure():
+    dot = program_to_dot(_program())
+    assert dot.startswith("digraph program {") and dot.endswith("}")
+    assert "mul" in dot                       # the fc matmul op box
+    assert "style=dashed" in dot              # grad ops are dashed
+    assert "peripheries=2" in dot             # the sgd update doubled
+    assert "fillcolor=lightgray" in dot       # parameter node
+    assert "->" in dot
+    # dataflow edges carry dtype/shape labels
+    assert "float32" in dot
+
+
+def test_text_dump_lists_every_op():
+    prog = _program()
+    text = program_to_text(prog)
+    for op in prog.global_block().desc.ops:
+        assert op.type in text
+    assert "block 0" in text
+
+
+def test_cli_over_saved_model(tmp_path):
+    prog = _program()
+    model = tmp_path / "model.json"
+    model.write_text(json.dumps({"program": prog.desc.to_dict()}))
+    out = tmp_path / "g.dot"
+    main([str(model), str(out)])
+    assert out.read_text().startswith("digraph")
