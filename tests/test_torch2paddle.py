@@ -1,0 +1,88 @@
+"""Torch weight import (reference: paddle/utils/torch2paddle.py) —
+fidelity-tested: the imported program must reproduce torch's forward
+outputs."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.utils.torch2paddle import (load_torch_state,
+                                           torch_state_to_numpy)
+
+
+def test_mlp_outputs_match():
+    tnet = torch.nn.Sequential(
+        torch.nn.Linear(13, 8), torch.nn.Tanh(),
+        torch.nn.Linear(8, 3))
+    x = np.random.RandomState(0).rand(5, 13).astype(np.float32)
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(x)).numpy()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[5, 13],
+                               dtype="float32", append_batch_size=False)
+        h = fluid.layers.fc(input=xv, size=8, act="tanh")
+        out = fluid.layers.fc(input=h, size=3, act=None)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    written = load_torch_state(main, tnet.state_dict(), scope=scope)
+    assert len(written) == 4
+    got, = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_conv_outputs_match():
+    tconv = torch.nn.Conv2d(3, 6, kernel_size=3, padding=1)
+    x = np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32)
+    with torch.no_grad():
+        want = tconv(torch.from_numpy(x)).numpy()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2, 3, 8, 8],
+                               dtype="float32", append_batch_size=False)
+        out = fluid.layers.conv2d(input=xv, num_filters=6,
+                                  filter_size=3, padding=1, act=None)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    load_torch_state(main, tconv.state_dict(), scope=scope)
+    got, = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_name_map_and_shape_guard():
+    tnet = torch.nn.Linear(4, 2)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[1, 4],
+                               dtype="float32", append_batch_size=False)
+        fluid.layers.fc(input=xv, size=2, act=None,
+                        param_attr=fluid.ParamAttr(name="w0"),
+                        bias_attr=fluid.ParamAttr(name="b0"))
+    scope = fluid.Scope()
+    written = load_torch_state(
+        main, tnet.state_dict(), scope=scope,
+        name_map={"w0": "weight", "b0": "bias"})
+    assert set(written) == {"w0", "b0"}
+    assert scope.get("w0").shape == (4, 2)     # transposed into [in,out]
+
+    bad = torch.nn.Linear(5, 2)                # wrong in-features
+    with pytest.raises(ValueError, match="does not fit"):
+        load_torch_state(main, bad.state_dict(), scope=scope,
+                         name_map={"w0": "weight"})
+
+
+def test_state_roundtrip_via_file(tmp_path):
+    tnet = torch.nn.Linear(3, 3)
+    p = str(tmp_path / "m.pt")
+    torch.save(tnet.state_dict(), p)
+    arrs = torch_state_to_numpy(p)
+    assert list(arrs) == ["weight", "bias"]
+    assert arrs["weight"].shape == (3, 3)
