@@ -1,0 +1,175 @@
+"""The full measurement suite in ONE process / one TPU claim.
+
+Every wedge observed on this tunnel hits a FRESH process's first big
+remote compile — claims stay instant, and compiles within an
+already-claimed process have worked back-to-back (bench warmup +
+profile traces).  So instead of one process per config (phase-1/2
+hunts: 27 wedged minutes per leg), this driver calls bench.py's main()
+once per config inside a single process: per-config env overrides are
+applied and FLAGS_* re-parsed (utils/flags.py is runtime state), and
+every successful run persists its record to BENCH_LAST_TPU.json
+immediately, so a mid-suite wedge keeps all completed measurements.
+
+Config order = information value: the regression-hunt factor legs
+(docs/PERF.md: default (bf16,fuse,shift) measured 1182.7 img/s vs
+r3config (f32,nofuse,two-pass) 2016.55 — which factor?), then the
+headline re-measure, batch-256, the model suite, inference rows, and
+the NHWC layout-pass A/B.
+
+Usage:  python scripts/mega_bench.py            # everything
+        MEGA_CONFIGS=f32act,nofuse python ...   # subset
+A config is skipped when BENCH_LAST_TPU.json already holds a record
+for it newer than MEGA_FRESH_SINCE (default: this round's start).
+"""
+
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+CONFIGS = [
+    # --- regression-hunt factor legs (resnet50 b128 bf16) ---
+    ("f32act", {"BENCH_TAG": "f32act", "FLAGS_amp_bf16_act": "0"}),
+    ("nofuse", {"BENCH_TAG": "nofuse", "FLAGS_fuse_optimizer": "0"}),
+    ("bnunshift", {"BENCH_TAG": "bnunshift",
+                   "FLAGS_bn_shifted_stats": "0"}),
+    ("smallfuse", {"BENCH_TAG": "smallfuse"}),
+    ("r3config", {"BENCH_TAG": "r3config", "FLAGS_amp_bf16_act": "0",
+                  "FLAGS_fuse_optimizer": "0",
+                  "FLAGS_bn_shifted_stats": "0"}),
+    # --- headline + batch/memory levers ---
+    ("default-b128", {}),
+    ("r3b256", {"BENCH_TAG": "r3b256", "BENCH_BATCH": "256",
+                "FLAGS_amp_bf16_act": "0", "FLAGS_fuse_optimizer": "0",
+                "FLAGS_bn_shifted_stats": "0"}),
+    ("b256", {"BENCH_BATCH": "256"}),
+    ("b256rcp8", {"BENCH_BATCH": "256", "BENCH_RECOMPUTE": "8"}),
+    ("nhwc-b128", {"BENCH_LAYOUT": "NHWC"}),
+    ("f32-b128", {"BENCH_AMP": "0"}),
+    # --- the model suite (BASELINE.md rows) ---
+    ("vgg16", {"BENCH_MODEL": "vgg16"}),
+    ("alexnet", {"BENCH_MODEL": "alexnet"}),
+    ("googlenet", {"BENCH_MODEL": "googlenet"}),
+    ("lstm", {"BENCH_MODEL": "lstm", "BENCH_BATCH": "256",
+              "BENCH_HIDDEN": "256"}),
+    ("transformer", {"BENCH_MODEL": "transformer"}),
+    # --- inference rows (IntelOptimizedPaddle.md:68-104) ---
+    ("infer-resnet50", {"BENCH_MODEL": "resnet50",
+                        "BENCH_MODE": "infer"}),
+    ("infer-vgg19", {"BENCH_MODEL": "vgg19", "BENCH_MODE": "infer"}),
+    ("infer-googlenet", {"BENCH_MODEL": "googlenet",
+                         "BENCH_MODE": "infer"}),
+    ("infer-alexnet", {"BENCH_MODEL": "alexnet",
+                       "BENCH_MODE": "infer"}),
+]
+
+_MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",
+            "BENCH_HIDDEN", "BENCH_RECOMPUTE", "BENCH_LAYOUT",
+            "BENCH_AMP", "FLAGS_amp_bf16_act", "FLAGS_fuse_optimizer",
+            "FLAGS_bn_shifted_stats")
+
+
+def _store():
+    try:
+        with open(bench._LAST_TPU_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _fresh_records(since):
+    return {k for k, r in _store().items()
+            if r.get("measured_at", 0) >= since}
+
+
+def run_one(name, overrides):
+    from paddle_tpu.fluid import amp
+    from paddle_tpu.utils import flags
+
+    saved = {k: os.environ.get(k) for k in _MANAGED}
+    for k in _MANAGED:
+        os.environ.pop(k, None)
+    os.environ.update(overrides)
+    flags.parse_flags_from_env()
+    for k in ("amp_bf16_act", "fuse_optimizer", "bn_shifted_stats"):
+        if "FLAGS_" + k not in overrides:
+            flags.set_flag(k, flags._FLAGS[k]["default"])
+    amp.disable_bf16()           # bench.main re-enables unless AMP=0
+    try:
+        bench.main()
+        return True
+    except BaseException as e:   # noqa: BLE001 — keep measuring
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        print("[mega] %s FAILED: %r" % (name, e), flush=True)
+        return False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        flags.parse_flags_from_env()
+        gc.collect()
+
+
+def main():
+    subset = os.environ.get("MEGA_CONFIGS")
+    names = subset.split(",") if subset else None
+    since = float(os.environ.get("MEGA_FRESH_SINCE",
+                                 time.time() - 6 * 3600))
+    os.environ.setdefault("BENCH_CLAIM_TIMEOUT", "0")
+
+    done_path = os.path.join(os.path.dirname(bench._LAST_TPU_PATH),
+                             "docs", "mega_done.json")
+    try:
+        with open(done_path) as f:
+            done = json.load(f)
+    except (OSError, ValueError):
+        done = {}
+
+    import jax
+
+    print("[mega] claiming: %s" % jax.devices(), flush=True)
+    ok = skipped = failed = 0
+    for name, overrides in CONFIGS:
+        if names is not None and name not in names:
+            continue
+        if done.get(name, 0) >= since:
+            print("[mega] %s already captured — skipping" % name,
+                  flush=True)
+            continue
+        before = _fresh_records(since)
+        t0 = time.perf_counter()
+        print("[mega] --- %s ---" % name, flush=True)
+        if run_one(name, overrides):
+            gained = _fresh_records(since) - before
+            if gained:
+                ok += 1
+                done[name] = time.time()
+                with open(done_path, "w") as f:
+                    json.dump(done, f, indent=1)
+                print("[mega] %s OK in %.0fs -> %s"
+                      % (name, time.perf_counter() - t0,
+                         sorted(gained)), flush=True)
+            else:
+                # ran but persisted nothing fresh: it was already
+                # captured (bench skips nothing itself) or ran on CPU
+                skipped += 1
+                print("[mega] %s ran without a fresh TPU record "
+                      "(%.0fs)" % (name, time.perf_counter() - t0),
+                      flush=True)
+        else:
+            failed += 1
+    print("[mega] done: %d measured, %d no-record, %d failed"
+          % (ok, skipped, failed), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# Phase-3 TPU capture loop: probe-gated, one-process suite.
+#
+# Wedge model learned this round (docs/PERF.md): the remote compile
+# service wedges FRESH processes' first big compile (~27 min then EOF)
+# while claims stay instant, and in-process follow-up compiles have
+# worked back-to-back.  So: a 120 s tiny-jit probe detects a healthy
+# compile path, then scripts/mega_bench.py measures EVERY pending
+# config inside one process / one claim, persisting each record the
+# moment it exists.  Progress survives any wedge; sweeps repeat until
+# the suite is complete, then two per-HLO profiles (factor-space ends)
+# close the session.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+log="docs/tpu_hunt.log"
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+export MEGA_FRESH_SINCE="${MEGA_FRESH_SINCE:-$(( $(date +%s) - 7200 ))}"
+
+say() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$log"; }
+
+compile_healthy() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+print(jax.jit(lambda x: x * 2 + 1)(jnp.arange(8.0))[3])" \
+    >>"$log" 2>&1
+}
+
+all_done() {
+  PYTHONPATH= JAX_PLATFORMS=cpu python - "$MEGA_FRESH_SINCE" <<'PY'
+import json, sys
+sys.path.insert(0, ".")
+from scripts.mega_bench import CONFIGS
+try:
+    done = json.load(open("docs/mega_done.json"))
+except Exception:
+    done = {}
+since = float(sys.argv[1])
+missing = [n for n, _ in CONFIGS if done.get(n, 0) < since]
+print("missing: %s" % (",".join(missing) or "none"))
+sys.exit(0 if not missing else 1)
+PY
+}
+
+profile_one() {  # profile_one <outfile> [ENV=VAL ...]
+  local out="$1"; shift
+  [ -s "$out" ] && { say "profile $out exists — skipping"; return 0; }
+  until compile_healthy; do
+    say "compile path wedged; probe again in 300s (pending: $out)"
+    sleep 300
+  done
+  say "profiling -> $out"
+  if env PROFILE_STEPS=10 "$@" timeout 2400 python scripts/profile_tpu.py \
+      >"$out" 2>&1; then
+    say "profile $out OK"
+  else
+    say "profile $out FAILED (rc=$?)"; return 1
+  fi
+}
+
+sweep=0
+while true; do
+  sweep=$((sweep + 1))
+  if all_done >>"$log" 2>&1; then
+    say "suite complete after $((sweep - 1)) sweeps"
+    break
+  fi
+  if compile_healthy; then
+    say "sweep $sweep: compile path healthy — running mega_bench"
+    if timeout 10800 python scripts/mega_bench.py >>"$log" 2>&1; then
+      say "sweep $sweep: mega_bench finished"
+    else
+      say "sweep $sweep: mega_bench exited rc=$? (wedge mid-suite?)"
+    fi
+  else
+    say "sweep $sweep: compile path wedged; sleeping 300"
+    sleep 300
+    continue
+  fi
+  sleep 60
+done
+
+profile_one docs/profile_r5_default.txt
+profile_one docs/profile_r5_r3config.txt FLAGS_amp_bf16_act=0 \
+  FLAGS_fuse_optimizer=0 FLAGS_bn_shifted_stats=0
+say "phase-3 hunt done"
