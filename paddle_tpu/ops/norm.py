@@ -99,29 +99,61 @@ def batch_norm(ctx, ins, attrs):
 
 @register_grad_kernel("batch_norm")
 def batch_norm_grad(ctx, ins, attrs):
-    """Explicit vjp of the normalization (running-stat updates carry no
-    gradient; reference: batch_norm_op.cc BatchNormGradKernel)."""
+    """Closed-form BN backward (reference: batch_norm_op.cc
+    BatchNormGradKernel — the same three-reduction formulation).
+
+    Deliberately NOT jax.vjp of the forward: the vjp threads f32
+    cotangents through the f32-upcast statistics path, and under the
+    bf16-activation policy that emits ~4 full-size f32 tensors per BN
+    (profiled via the StableHLO: 106 big bf16->f32 converts + 265 big
+    f32 broadcasts across ResNet-50) — materialization bait that
+    doubles the elementwise HBM bytes the policy exists to halve.
+    Here every full-size operand stays in x's dtype: the two
+    reductions accumulate in f32 with the converts fused into the
+    sweep (same contract as _bn_stats), and dx is one affine
+    ``A*dy + B*x + D`` whose per-channel f32 coefficients fold ALL
+    statistics before a single downcast of [C]-sized vectors.
+
+        g1 = sum(dy); g2 = sum(dy * (x - m)); inv = rsqrt(v + eps)
+        A = scale*inv;  B = -scale*inv^3*g2/N;  D = -A*g1/N - B*m
+        dscale = inv*g2; dbias = g1       (test mode: B = D = 0)
+    """
     x = ins["X"][0]
     scale = ins["Scale"][0]
-    bias = ins["Bias"][0]
     dy = ins["OG@Y"][0]
     eps = attrs.get("epsilon", 1e-5)
     is_test = attrs.get("is_test", False)
     layout = attrs.get("data_layout", "NCHW")
-    mean = ins["Mean"][0]
-    variance = ins["Variance"][0]
 
-    def f(x_, scale_, bias_):
-        axes, bshape = _bn_axes(x_, layout)
-        if is_test:
-            m, v = mean, variance
-        else:
-            m, v = _bn_stats(x_, axes)
-        return _bn_normalize(x_, scale_, bias_, m, v, eps, bshape)
+    axes, bshape = _bn_axes(x, layout)
+    if is_test:
+        m = ins["Mean"][0]
+        v = ins["Variance"][0]
+    elif "SavedMean" in ins:
+        m = ins["SavedMean"][0]
+        v = ins["SavedVariance"][0]
+    else:
+        m, v = _bn_stats(x, axes)
+    inv = jax.lax.rsqrt(v.astype(jnp.float32) + eps)
 
-    _, vjp = jax.vjp(f, x, scale, bias)
-    dx, dscale, dbias = vjp(dy)
-    return {"X@GRAD": [dx], "Scale@GRAD": [dscale], "Bias@GRAD": [dbias]}
+    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+    dys = dy if dy.dtype == jnp.float32 else dy.astype(jnp.float32)
+    g1 = jnp.sum(dys, axis=axes)
+    g2 = jnp.sum(dys * (xs - m.reshape(bshape)), axis=axes)
+
+    a = scale * inv
+    if is_test:
+        dx = dy * a.reshape(bshape).astype(dy.dtype)
+    else:
+        n = 1
+        for ax in axes:
+            n *= x.shape[ax]
+        b = -a * jnp.square(inv) * g2 / n
+        d = -(a * g1) / n - b * m
+        dx = (dy * a.reshape(bshape).astype(dy.dtype)
+              + x * b.reshape(bshape).astype(x.dtype)
+              + d.reshape(bshape).astype(x.dtype))
+    return {"X@GRAD": [dx], "Scale@GRAD": [inv * g2], "Bias@GRAD": [g1]}
 
 
 @register_op("layer_norm")

