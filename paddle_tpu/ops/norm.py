@@ -177,6 +177,64 @@ def layer_norm(ctx, ins, attrs):
             "Variance": [v.reshape(lead)]}
 
 
+@register_grad_kernel("layer_norm")
+def layer_norm_grad(ctx, ins, attrs):
+    """Closed-form LN backward (reference: layer_norm_op.cc grad
+    kernels) — same rationale as batch_norm_grad above: the generic
+    vjp re-materializes the f32 statistics chain at full size under
+    the bf16-activation policy; here the full-size math runs in x's
+    dtype with per-row f32 coefficients (inv, the two row-reductions)
+    folded before a single downcast.
+
+        dy' = dy ⊙ scale;  g1 = Σ_j dy';  g2 = Σ_j dy'·(x-m)
+        dx = dy'·inv + x·B + D,  B = -inv³·g2/N,  D = -inv·g1/N - B·m
+        dscale_j = Σ_r dy·(x-m)·inv;  dbias_j = Σ_r dy
+    """
+    x = ins["X"][0]
+    dy = ins["OG@Y"][0]
+    begin = int(attrs.get("begin_norm_axis", 1))
+    eps = attrs.get("epsilon", 1e-5)
+    lead = 1
+    for d in x.shape[:begin]:
+        lead *= d
+    x2 = x.reshape(lead, -1)
+    dy2 = dy.reshape(lead, -1)
+    n = x2.shape[1]
+
+    xs = x2 if x2.dtype == jnp.float32 else x2.astype(jnp.float32)
+    if "Mean" in ins:                 # saved by the forward op
+        m = ins["Mean"][0].reshape(lead, 1).astype(jnp.float32)
+        v = ins["Variance"][0].reshape(lead, 1).astype(jnp.float32)
+    else:                             # pruned program: recompute (fuses)
+        m = jnp.mean(xs, axis=1, keepdims=True)
+        v = jnp.var(xs, axis=1, keepdims=True)
+    inv = jax.lax.rsqrt(v + eps)
+
+    dys = dy2 if dy2.dtype == jnp.float32 else dy2.astype(jnp.float32)
+    xc = xs - m                       # f32, fuses into the reductions
+
+    has_scale = "Scale" in ins
+    if has_scale:
+        scale = ins["Scale"][0].reshape(1, -1)
+        dyp = dys * scale
+    else:
+        dyp = dys
+    g1 = jnp.sum(dyp, axis=1, keepdims=True)
+    g2 = jnp.sum(dyp * xc, axis=1, keepdims=True)
+
+    b = -jnp.power(inv, 3) * g2 / n
+    d = -inv * g1 / n - b * m
+    dyp_lowp = (dy2 * scale.astype(dy2.dtype)) if has_scale else dy2
+    dx2 = (dyp_lowp * inv.astype(dy2.dtype)
+           + x2 * b.astype(x2.dtype) + d.astype(x2.dtype))
+    out = {"X@GRAD": [dx2.reshape(x.shape)]}
+    if has_scale:
+        out["Scale@GRAD"] = [jnp.sum(dys * xc * inv, axis=0)]
+    if "Bias" in ins:
+        out["Bias@GRAD"] = [jnp.sum(dys, axis=0)]
+    return out
+
+
 @register_op("norm")
 def norm(ctx, ins, attrs):
     """L2-normalize along axis (reference: norm_op.cc)."""

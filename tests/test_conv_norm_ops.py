@@ -397,6 +397,45 @@ class TestLayerNorm(OpTest):
         self.check_output(atol=1e-4, no_check_set=("Mean", "Variance"))
 
 
+class TestLayerNormGrad(OpTest):
+    """Closed-form LN backward vs central differences (same noise
+    considerations as the BN grad checks above)."""
+    op_type = "layer_norm"
+
+    def test(self):
+        x = RS.rand(4, 6).astype("float32")
+        scale = RS.rand(6).astype("float32") + 0.5
+        bias = RS.rand(6).astype("float32")
+        eps = 1e-5
+        mu = x.mean(axis=1, keepdims=True)
+        sig2 = x.var(axis=1, keepdims=True)
+        ref = (x - mu) / np.sqrt(sig2 + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Y": ref}
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+class TestLayerNormGradNoAffine(OpTest):
+    """Optional Scale/Bias absent: only X@GRAD is produced."""
+    op_type = "layer_norm"
+
+    def test(self):
+        x = RS.rand(3, 3, 4).astype("float32")
+        eps = 1e-5
+        x2 = x.reshape(9, 4)
+        mu = x2.mean(axis=1, keepdims=True)
+        sig2 = x2.var(axis=1, keepdims=True)
+        ref = ((x2 - mu) / np.sqrt(sig2 + eps)).reshape(x.shape)
+        self.inputs = {"X": x}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 2}
+        self.outputs = {"Y": ref}
+        self.check_grad(["X"], "Y", max_relative_error=0.03,
+                        numeric_delta=1e-2, atol=5e-3)
+
+
 class TestLRN(OpTest):
     op_type = "lrn"
 
