@@ -14,6 +14,30 @@ from .registry import register_op, register_grad_kernel
 from ..utils import flags
 
 
+def _slot0(ins, slot):
+    """First entry of an optional grad-op slot, or None.
+
+    backward.py feeds forward outputs prefixed ``O@<slot>`` and output
+    grads as ``OG@<slot>`` with absent grads mapped to None by the
+    executor, so both "slot missing" and "slot empty" mean None here.
+    """
+    vs = ins.get(slot)
+    return vs[0] if vs else None
+
+
+def _stat_cotangent(ins, saved_slot, out_slot, momentum):
+    """Total f32 cotangent reaching a batch statistic that is exposed
+    both directly (Saved*) and blended into the running stat (*Out) at
+    weight (1 - momentum); None when neither path carries a gradient."""
+    g = _slot0(ins, saved_slot)
+    total = None if g is None else g.astype(jnp.float32)
+    g = _slot0(ins, out_slot)
+    if g is not None:
+        g = (1.0 - momentum) * g.astype(jnp.float32)
+        total = g if total is None else total + g
+    return total
+
+
 def _bn_axes(x, layout):
     if layout == "NCHW":
         return (tuple(i for i in range(x.ndim) if i != 1),
@@ -123,33 +147,61 @@ def batch_norm_grad(ctx, ins, attrs):
     dy = ins["OG@Y"][0]
     eps = attrs.get("epsilon", 1e-5)
     is_test = attrs.get("is_test", False)
+    momentum = attrs.get("momentum", 0.9)
     layout = attrs.get("data_layout", "NCHW")
 
     axes, bshape = _bn_axes(x, layout)
     if is_test:
-        m = ins["Mean"][0]
-        v = ins["Variance"][0]
-    elif "SavedMean" in ins:
-        m = ins["SavedMean"][0]
-        v = ins["SavedVariance"][0]
+        m = ins["Mean"][0].astype(jnp.float32)
+        v = ins["Variance"][0].astype(jnp.float32)
     else:
-        m, v = _bn_stats(x, axes)
-    inv = jax.lax.rsqrt(v.astype(jnp.float32) + eps)
+        sm = _slot0(ins, "O@SavedMean")
+        sv = _slot0(ins, "O@SavedVariance")
+        if sm is not None and sv is not None:
+            m, v = sm.astype(jnp.float32), sv.astype(jnp.float32)
+        else:
+            m, v = _bn_stats(x, axes)
+    inv = jax.lax.rsqrt(v + eps)
 
-    xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
-    dys = dy if dy.dtype == jnp.float32 else dy.astype(jnp.float32)
-    g1 = jnp.sum(dys, axis=axes)
-    g2 = jnp.sum(dys * (xs - m.reshape(bshape)), axis=axes)
+    if dy is None:
+        g1 = jnp.zeros_like(m)
+        g2 = jnp.zeros_like(m)
+    else:
+        xs = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
+        dys = dy if dy.dtype == jnp.float32 else dy.astype(jnp.float32)
+        g1 = jnp.sum(dys, axis=axes)
+        g2 = jnp.sum(dys * (xs - m.reshape(bshape)), axis=axes)
 
     a = scale * inv
+    n = 1
+    for ax in axes:
+        n *= x.shape[ax]
     if is_test:
-        dx = dy * a.reshape(bshape).astype(dy.dtype)
+        # running stats are nondiff inputs: only the Y path carries grad
+        dx = jnp.zeros_like(x) if dy is None else \
+            dy * a.reshape(bshape).astype(dy.dtype)
+        return {"X@GRAD": [dx], "Scale@GRAD": [inv * g2],
+                "Bias@GRAD": [g1]}
+
+    b = -a * jnp.square(inv) * g2 / n
+    d = -(a * g1) / n - b * m
+    # cotangents through the statistic outputs: SavedMean/SavedVariance
+    # are the batch stats, MeanOut/VarianceOut blend them with the
+    # (nondiff) running stats at weight (1-momentum).  d mean/dx = 1/n,
+    # d var/dx = 2(x-m)/n, so they fold into the same affine: one extra
+    # per-channel term in b and d, no extra full-size pass.
+    dm = _stat_cotangent(ins, "OG@SavedMean", "OG@MeanOut", momentum)
+    dv = _stat_cotangent(ins, "OG@SavedVariance", "OG@VarianceOut",
+                         momentum)
+    if dv is not None:
+        b = b + 2.0 * dv / n
+        d = d - 2.0 * dv * m / n
+    if dm is not None:
+        d = d + dm / n
+    if dy is None:
+        dx = x * b.reshape(bshape).astype(x.dtype) + \
+            d.reshape(bshape).astype(x.dtype)
     else:
-        n = 1
-        for ax in axes:
-            n *= x.shape[ax]
-        b = -a * jnp.square(inv) * g2 / n
-        d = -(a * g1) / n - b * m
         dx = (dy * a.reshape(bshape).astype(dy.dtype)
               + x * b.reshape(bshape).astype(x.dtype)
               + d.reshape(bshape).astype(x.dtype))
@@ -198,40 +250,57 @@ def layer_norm_grad(ctx, ins, attrs):
     for d in x.shape[:begin]:
         lead *= d
     x2 = x.reshape(lead, -1)
-    dy2 = dy.reshape(lead, -1)
     n = x2.shape[1]
 
     xs = x2 if x2.dtype == jnp.float32 else x2.astype(jnp.float32)
-    if "Mean" in ins:                 # saved by the forward op
-        m = ins["Mean"][0].reshape(lead, 1).astype(jnp.float32)
-        v = ins["Variance"][0].reshape(lead, 1).astype(jnp.float32)
+    sm = _slot0(ins, "O@Mean")        # saved by the forward op
+    sv = _slot0(ins, "O@Variance")
+    if sm is not None and sv is not None:
+        m = sm.reshape(lead, 1).astype(jnp.float32)
+        v = sv.reshape(lead, 1).astype(jnp.float32)
     else:                             # pruned program: recompute (fuses)
         m = jnp.mean(xs, axis=1, keepdims=True)
         v = jnp.var(xs, axis=1, keepdims=True)
     inv = jax.lax.rsqrt(v + eps)
-
-    dys = dy2 if dy2.dtype == jnp.float32 else dy2.astype(jnp.float32)
     xc = xs - m                       # f32, fuses into the reductions
 
     has_scale = "Scale" in ins
-    if has_scale:
-        scale = ins["Scale"][0].reshape(1, -1)
-        dyp = dys * scale
+    scale = ins["Scale"][0].reshape(1, -1) if has_scale else None
+    if dy is None:
+        zrow = jnp.zeros((lead, 1), jnp.float32)
+        g1, g2 = zrow, zrow
     else:
-        dyp = dys
-    g1 = jnp.sum(dyp, axis=1, keepdims=True)
-    g2 = jnp.sum(dyp * xc, axis=1, keepdims=True)
+        dy2 = dy.reshape(lead, -1)
+        dys = dy2 if dy2.dtype == jnp.float32 else dy2.astype(jnp.float32)
+        dyp = dys * scale if has_scale else dys
+        g1 = jnp.sum(dyp, axis=1, keepdims=True)
+        g2 = jnp.sum(dyp * xc, axis=1, keepdims=True)
 
     b = -jnp.power(inv, 3) * g2 / n
     d = -inv * g1 / n - b * m
-    dyp_lowp = (dy2 * scale.astype(dy2.dtype)) if has_scale else dy2
-    dx2 = (dyp_lowp * inv.astype(dy2.dtype)
-           + x2 * b.astype(x2.dtype) + d.astype(x2.dtype))
+    # Mean/Variance output cotangents fold into the same per-row affine
+    # (d mean/dx = 1/n, d var/dx = 2(x-m)/n) — no extra full-size pass
+    dm = _slot0(ins, "OG@Mean")
+    dv = _slot0(ins, "OG@Variance")
+    if dv is not None:
+        dv = dv.reshape(lead, 1).astype(jnp.float32)
+        b = b + 2.0 * dv / n
+        d = d - 2.0 * dv * m / n
+    if dm is not None:
+        d = d + dm.reshape(lead, 1).astype(jnp.float32) / n
+    dx2 = x2 * b.astype(x2.dtype) + d.astype(x2.dtype)
+    if dy is not None:
+        dyp_lowp = (dy2 * scale.astype(dy2.dtype)) if has_scale else dy2
+        dx2 = dx2 + dyp_lowp * inv.astype(dy2.dtype)
     out = {"X@GRAD": [dx2.reshape(x.shape)]}
     if has_scale:
-        out["Scale@GRAD"] = [jnp.sum(dys * xc * inv, axis=0)]
+        sg = jnp.sum(dys * xc * inv, axis=0) if dy is not None else \
+            jnp.zeros(x2.shape[1], jnp.float32)
+        out["Scale@GRAD"] = [sg]
     if "Bias" in ins:
-        out["Bias@GRAD"] = [jnp.sum(dys, axis=0)]
+        bg = jnp.sum(dys, axis=0) if dy is not None else \
+            jnp.zeros(x2.shape[1], jnp.float32)
+        out["Bias@GRAD"] = [bg]
     return out
 
 

@@ -43,6 +43,15 @@ CONFIGS = [
     ("r3config", {"BENCH_TAG": "r3config", "FLAGS_amp_bf16_act": "0",
                   "FLAGS_fuse_optimizer": "0",
                   "FLAGS_bn_shifted_stats": "0"}),
+    # --- combined winner from the factor legs (bnunshift 2471 >
+    # nofuse 2171 > smallfuse 2129 img/s): unshifted BN is the big
+    # lever, fusion a small cost; bnunshift already measures the
+    # unshifted+fused combination ---
+    ("best", {"BENCH_TAG": "best", "FLAGS_bn_shifted_stats": "0",
+              "FLAGS_fuse_optimizer": "0"}),
+    ("bestb256", {"BENCH_TAG": "bestb256", "BENCH_BATCH": "256",
+                  "FLAGS_bn_shifted_stats": "0",
+                  "FLAGS_fuse_optimizer": "0"}),
     # --- headline + batch/memory levers ---
     ("default-b128", {}),
     ("r3b256", {"BENCH_TAG": "r3b256", "BENCH_BATCH": "256",

@@ -423,9 +423,14 @@ class TestLayerNormGradNoAffine(OpTest):
     op_type = "layer_norm"
 
     def test(self):
-        x = RS.rand(3, 3, 4).astype("float32")
+        # own seed + wider rows: central differences at delta=1e-2 on
+        # 4-element normalization rows carry ~0.08 truncation error,
+        # and the shared module RandomState made the draw depend on
+        # which tests ran first (flaked under pytest -k subsets)
+        rs = np.random.RandomState(11)
+        x = rs.rand(3, 3, 8).astype("float32")
         eps = 1e-5
-        x2 = x.reshape(9, 4)
+        x2 = x.reshape(9, 8)
         mu = x2.mean(axis=1, keepdims=True)
         sig2 = x2.var(axis=1, keepdims=True)
         ref = ((x2 - mu) / np.sqrt(sig2 + eps)).reshape(x.shape)
@@ -433,7 +438,7 @@ class TestLayerNormGradNoAffine(OpTest):
         self.attrs = {"epsilon": eps, "begin_norm_axis": 2}
         self.outputs = {"Y": ref}
         self.check_grad(["X"], "Y", max_relative_error=0.03,
-                        numeric_delta=1e-2, atol=5e-3)
+                        numeric_delta=1e-3, atol=5e-3)
 
 
 class TestLRN(OpTest):
@@ -489,3 +494,134 @@ class TestDropoutInfer(OpTest):
         self.attrs = {"dropout_prob": 0.35, "is_test": True}
         self.outputs = {"Out": x * (1 - 0.35)}
         self.check_output(no_check_set=("Mask",))
+
+
+class TestBatchNormGradSavedStats(OpTest):
+    """With the full output set declared (as production programs built
+    by fluid.layers.batch_norm do), the grad op receives the forward's
+    SavedMean/SavedVariance as O@-slots and must reuse them instead of
+    re-sweeping X — and still match central differences."""
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 3
+        x = RS.rand(4, c, 3, 3).astype("float32")
+        scale = RS.rand(c).astype("float32") + 0.5
+        bias = RS.rand(c).astype("float32")
+        mean = np.zeros(c, "float32")
+        var = np.ones(c, "float32")
+        eps = 1e-5
+        mu = x.mean(axis=(0, 2, 3))
+        sig2 = x.var(axis=(0, 2, 3))
+        ref = (x - mu.reshape(1, c, 1, 1)) / np.sqrt(
+            sig2.reshape(1, c, 1, 1) + eps) * scale.reshape(1, c, 1, 1) \
+            + bias.reshape(1, c, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": False, "epsilon": eps, "momentum": 0.9}
+        self.outputs = {"Y": ref,
+                        "MeanOut": 0.9 * mean + 0.1 * mu,
+                        "VarianceOut": 0.9 * var + 0.1 * sig2,
+                        "SavedMean": mu, "SavedVariance": sig2}
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+class TestBatchNormGradThroughStats(OpTest):
+    """Gradient flowing ONLY through the statistic outputs (OG@Y is
+    empty): the closed-form backward must fold the SavedMean/
+    SavedVariance/MeanOut cotangents into dx instead of crashing or
+    dropping them (the generic vjp it replaced handled this case)."""
+    op_type = "batch_norm"
+
+    def test(self):
+        c = 2
+        x = RS.rand(3, c, 2, 2).astype("float32")
+        scale = RS.rand(c).astype("float32") + 0.5
+        bias = RS.rand(c).astype("float32")
+        mean = np.zeros(c, "float32")
+        var = np.ones(c, "float32")
+        eps = 1e-5
+        mu = x.mean(axis=(0, 2, 3))
+        sig2 = x.var(axis=(0, 2, 3))
+        ref = (x - mu.reshape(1, c, 1, 1)) / np.sqrt(
+            sig2.reshape(1, c, 1, 1) + eps) * scale.reshape(1, c, 1, 1) \
+            + bias.reshape(1, c, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": False, "epsilon": eps, "momentum": 0.9}
+        self.outputs = {"Y": ref,
+                        "MeanOut": 0.9 * mean + 0.1 * mu,
+                        "VarianceOut": 0.9 * var + 0.1 * sig2,
+                        "SavedMean": mu, "SavedVariance": sig2}
+        self.check_grad(["X"], ["SavedMean", "SavedVariance", "MeanOut"],
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+class TestLayerNormGradSavedStats(OpTest):
+    """Full output set declared: the LN backward must reuse the
+    forward's O@Mean/O@Variance (not re-reduce X) and stay correct."""
+    op_type = "layer_norm"
+
+    def test(self):
+        x = RS.rand(4, 6).astype("float32")
+        scale = RS.rand(6).astype("float32") + 0.5
+        bias = RS.rand(6).astype("float32")
+        eps = 1e-5
+        mu = x.mean(axis=1)
+        sig2 = x.var(axis=1)
+        ref = (x - mu[:, None]) / np.sqrt(sig2[:, None] + eps) * scale \
+            + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Y": ref, "Mean": mu, "Variance": sig2}
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+class TestLayerNormGradThroughStats(OpTest):
+    """Gradient only through Mean/Variance (OG@Y empty): the per-row
+    cotangents fold into dx; Scale/Bias get zero grads but no crash."""
+    op_type = "layer_norm"
+
+    def test(self):
+        x = RS.rand(4, 6).astype("float32")
+        scale = RS.rand(6).astype("float32") + 0.5
+        bias = RS.rand(6).astype("float32")
+        eps = 1e-5
+        mu = x.mean(axis=1)
+        sig2 = x.var(axis=1)
+        ref = (x - mu[:, None]) / np.sqrt(sig2[:, None] + eps) * scale \
+            + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Y": ref, "Mean": mu, "Variance": sig2}
+        self.check_grad(["X"], ["Mean", "Variance"],
+                        max_relative_error=0.03, numeric_delta=1e-2,
+                        atol=5e-3)
+
+
+def test_bn_grad_reads_saved_stats_slot():
+    """The saved-stats fast path must actually READ O@SavedMean/
+    O@SavedVariance: feeding deliberately wrong saved stats must change
+    dx vs the recompute fallback (guards the slot name against the
+    O@-prefix regression this test was written for)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry
+
+    kern = registry.get_op_info("batch_norm").grad_kernel
+    x = jnp.asarray(RS.rand(2, 3, 2, 2).astype("float32"))
+    dy = jnp.asarray(RS.rand(2, 3, 2, 2).astype("float32"))
+    scale = jnp.ones(3, jnp.float32)
+    base = {"X": [x], "Scale": [scale], "OG@Y": [dy]}
+    attrs = {"is_test": False, "epsilon": 1e-5, "momentum": 0.9}
+    dx_recompute = kern(None, dict(base), attrs)["X@GRAD"][0]
+    wrong = {**base, "O@SavedMean": [jnp.full(3, 7.0)],
+             "O@SavedVariance": [jnp.full(3, 9.0)]}
+    dx_saved = kern(None, wrong, attrs)["X@GRAD"][0]
+    assert not np.allclose(np.asarray(dx_recompute),
+                           np.asarray(dx_saved)), \
+        "grad kernel ignored the saved statistics slots"
