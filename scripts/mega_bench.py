@@ -10,14 +10,16 @@ applied and FLAGS_* re-parsed (utils/flags.py is runtime state), and
 every successful run persists its record to BENCH_LAST_TPU.json
 immediately, so a mid-suite wedge keeps all completed measurements.
 
-Config order = information value: the regression-hunt factor legs
-(docs/PERF.md: default (bf16,fuse,shift) measured 1182.7 img/s vs
-r3config (f32,nofuse,two-pass) 2016.55 — which factor?), then the
-headline re-measure, batch-256, the model suite, inference rows, and
-the NHWC layout-pass A/B.
+Config order = information value: the headline (the sweep-1 factor
+hunt concluded bf16-act + unfused + plain BN stats wins — now the
+default), then single-factor A/B legs each pinning its flags
+EXPLICITLY relative to that default (run_one resets un-overridden
+flags to registered defaults, so a tag must never rely on a default
+it means to vary), then batch/memory/layout levers, the model suite,
+inference rows, and last the googlenet compile that hung sweep 1.
 
 Usage:  python scripts/mega_bench.py            # everything
-        MEGA_CONFIGS=f32act,nofuse python ...   # subset
+        MEGA_CONFIGS=f32act,fused python ...    # subset
 A config is skipped when BENCH_LAST_TPU.json already holds a record
 for it newer than MEGA_FRESH_SINCE (default: this round's start).
 """
@@ -34,29 +36,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import bench  # noqa: E402
 
 CONFIGS = [
-    # --- regression-hunt factor legs (resnet50 b128 bf16) ---
+    # --- headline: the sweep-1 winner is now the flag default
+    # (bf16 activations, unfused updates, plain one-pass BN stats),
+    # plus the saved-stats backward fix — re-measure first ---
+    ("default-b128", {}),
+    # --- single-factor A/B legs vs that default (each pins only the
+    # factor it varies; defaults cover the rest) ---
     ("f32act", {"BENCH_TAG": "f32act", "FLAGS_amp_bf16_act": "0"}),
-    ("nofuse", {"BENCH_TAG": "nofuse", "FLAGS_fuse_optimizer": "0"}),
-    ("bnunshift", {"BENCH_TAG": "bnunshift",
-                   "FLAGS_bn_shifted_stats": "0"}),
-    ("smallfuse", {"BENCH_TAG": "smallfuse"}),
+    ("fused", {"BENCH_TAG": "fused", "FLAGS_fuse_optimizer": "1"}),
+    ("bnshifted", {"BENCH_TAG": "bnshifted",
+                   "FLAGS_bn_shifted_stats": "1"}),
     ("r3config", {"BENCH_TAG": "r3config", "FLAGS_amp_bf16_act": "0",
                   "FLAGS_fuse_optimizer": "0",
                   "FLAGS_bn_shifted_stats": "0"}),
-    # --- combined winner from the factor legs (bnunshift 2471 >
-    # nofuse 2171 > smallfuse 2129 img/s): unshifted BN is the big
-    # lever, fusion a small cost; bnunshift already measures the
-    # unshifted+fused combination ---
-    ("best", {"BENCH_TAG": "best", "FLAGS_bn_shifted_stats": "0",
-              "FLAGS_fuse_optimizer": "0"}),
-    ("bestb256", {"BENCH_TAG": "bestb256", "BENCH_BATCH": "256",
-                  "FLAGS_bn_shifted_stats": "0",
-                  "FLAGS_fuse_optimizer": "0"}),
-    # --- headline + batch/memory levers ---
-    ("default-b128", {}),
-    ("r3b256", {"BENCH_TAG": "r3b256", "BENCH_BATCH": "256",
-                "FLAGS_amp_bf16_act": "0", "FLAGS_fuse_optimizer": "0",
-                "FLAGS_bn_shifted_stats": "0"}),
+    # --- batch/memory levers ---
     ("b256", {"BENCH_BATCH": "256"}),
     ("b256rcp8", {"BENCH_BATCH": "256", "BENCH_RECOMPUTE": "8"}),
     ("nhwc-b128", {"BENCH_LAYOUT": "NHWC"}),
@@ -64,7 +57,6 @@ CONFIGS = [
     # --- the model suite (BASELINE.md rows) ---
     ("vgg16", {"BENCH_MODEL": "vgg16"}),
     ("alexnet", {"BENCH_MODEL": "alexnet"}),
-    ("googlenet", {"BENCH_MODEL": "googlenet"}),
     ("lstm", {"BENCH_MODEL": "lstm", "BENCH_BATCH": "256",
               "BENCH_HIDDEN": "256"}),
     ("transformer", {"BENCH_MODEL": "transformer"}),
@@ -76,6 +68,10 @@ CONFIGS = [
                          "BENCH_MODE": "infer"}),
     ("infer-alexnet", {"BENCH_MODEL": "alexnet",
                        "BENCH_MODE": "infer"}),
+    # last: its ~1500-op inception graph is the one compile that has
+    # hung the remote compile service (sweep 1: >40 min, killed) — a
+    # hang here can only cost this leg, not the suite
+    ("googlenet", {"BENCH_MODEL": "googlenet"}),
 ]
 
 _MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",

@@ -14,6 +14,18 @@ import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import fusion
+from paddle_tpu.utils import flags as _flags
+
+
+@pytest.fixture(autouse=True)
+def _fusion_on():
+    """This file tests the fusion pass itself, so force the flag on
+    (default is off: the measured TPU A/B showed the stack is a small
+    net loss under XLA — see utils/flags.py)."""
+    prev = _flags.get_flag("fuse_optimizer")
+    _flags.set_flag("fuse_optimizer", True)
+    yield
+    _flags.set_flag("fuse_optimizer", prev)
 
 
 def _build_convnet(optimizer_fn, seed=7):
