@@ -21,7 +21,7 @@ export MEGA_FRESH_SINCE="${MEGA_FRESH_SINCE:-$(( $(date +%s) - 7200 ))}"
 say() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$log"; }
 
 compile_healthy() {
-  timeout 120 python -c "
+  timeout 180 python -c "
 import jax, jax.numpy as jnp
 print(jax.jit(lambda x: x * 2 + 1)(jnp.arange(8.0))[3])" \
     >>"$log" 2>&1
@@ -48,7 +48,7 @@ profile_one() {  # profile_one <outfile> [ENV=VAL ...]
   [ -s "$out" ] && { say "profile $out exists — skipping"; return 0; }
   until compile_healthy; do
     say "compile path wedged; probe again in 300s (pending: $out)"
-    sleep 300
+    sleep 480
   done
   say "profiling -> $out"
   if env PROFILE_STEPS=10 "$@" timeout 2400 python scripts/profile_tpu.py \
@@ -75,7 +75,7 @@ while true; do
     fi
   else
     say "sweep $sweep: compile path wedged; sleeping 300"
-    sleep 300
+    sleep 480
     continue
   fi
   sleep 60
