@@ -4,7 +4,7 @@
 # Wedge model learned this round (docs/PERF.md): the remote compile
 # service wedges FRESH processes' first big compile (~27 min then EOF)
 # while claims stay instant, and in-process follow-up compiles have
-# worked back-to-back.  So: a 120 s tiny-jit probe detects a healthy
+# worked back-to-back.  So: a 180 s tiny-jit probe detects a healthy
 # compile path, then scripts/mega_bench.py measures EVERY pending
 # config inside one process / one claim, persisting each record the
 # moment it exists.  Progress survives any wedge; sweeps repeat until
@@ -47,7 +47,7 @@ profile_one() {  # profile_one <outfile> [ENV=VAL ...]
   local out="$1"; shift
   [ -s "$out" ] && { say "profile $out exists — skipping"; return 0; }
   until compile_healthy; do
-    say "compile path wedged; probe again in 300s (pending: $out)"
+    say "compile path wedged; probe again in 480s (pending: $out)"
     sleep 480
   done
   say "profiling -> $out"
@@ -74,7 +74,7 @@ while true; do
       say "sweep $sweep: mega_bench exited rc=$? (wedge mid-suite?)"
     fi
   else
-    say "sweep $sweep: compile path wedged; sleeping 300"
+    say "sweep $sweep: compile path wedged; sleeping 480"
     sleep 480
     continue
   fi

@@ -223,3 +223,52 @@ def test_bf16_lstm_training_step():
             for _ in range(4)]
     assert all(np.isfinite(l) for l in losses), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_bn_backward_reuses_forward_statistics():
+    """IR-level perf contract: the whole conv+BN training step must
+    contain exactly 5 per-channel (0,2,3) reductions — 2 forward
+    statistics (sum, sum-of-squares), 2 backward grad sums (g1, g2),
+    and the conv bias grad.  A 6th/7th reduction means batch_norm_grad
+    stopped reusing the forward's SavedMean/SavedVariance (the O@-slot
+    regression fixed this round) and is re-sweeping the activation."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.jit import FunctionalProgram, state_from_scope
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid.executor import scope_guard
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8, 16, 16],
+                              dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=x, num_filters=8, filter_size=3,
+                                padding=1)
+        bn = fluid.layers.batch_norm(input=c, act="relu")
+        p = fluid.layers.pool2d(input=bn, pool_size=16, pool_type="avg")
+        logits = fluid.layers.fc(input=p, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=logits, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(loss)
+
+    with scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fp = FunctionalProgram(main, ["x", "y"], [loss.name])
+        state = state_from_scope(fp)
+        feeds = {"x": jnp.zeros((4, 8, 16, 16), jnp.float32),
+                 "y": jnp.zeros((4, 1), jnp.int32)}
+        jaxpr = str(jax.make_jaxpr(lambda s, f: fp(s, f))(state, feeds))
+    per_channel = len(re.findall(r"axes=\(0, 2, 3\)", jaxpr))
+    assert per_channel == 5, (
+        "expected 5 per-channel reductions (2 fwd stats + 2 bwd sums "
+        "+ conv bias grad), found %d — batch_norm_grad is re-sweeping "
+        "the activation instead of reusing saved statistics"
+        % per_channel)
