@@ -214,10 +214,33 @@ def prune_program(program, targets):
     return pruned
 
 
+def _feed_meta(program, feed_names):
+    """Shape/dtype/lod metadata for each feed var — what an online
+    server needs to synthesize warmup batches and validate request
+    payloads without rebuilding the topology (see serving/engine.py)."""
+    from ..core.types import np_dtype
+
+    block = program.global_block()
+    meta = {}
+    for name in feed_names:
+        var = block.var(name)
+        dtype = (np.dtype(np_dtype(var.dtype)).name
+                 if var.dtype is not None else None)
+        meta[name] = {"shape": list(var.shape), "dtype": dtype,
+                      "lod_level": var.lod_level}
+    return meta
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
-                         main_program=None, model_filename="__model__"):
+                         main_program=None, model_filename="__model__",
+                         bucket_hints=None):
     """reference: io.py:237 — writes the pruned inference ProgramDesc plus
-    all persistable params."""
+    all persistable params.
+
+    `bucket_hints` (optional dict, e.g. ``{"batch_buckets": [1, 8, 32],
+    "token_bucket": 64}``) records the shape buckets the exporter
+    expects to serve under; `serving.InferenceEngine.from_saved_model`
+    seeds its compile-cache config from them."""
     if main_program is None:
         main_program = default_main_program()
     if isinstance(feeded_var_names, str):
@@ -232,15 +255,21 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_names": list(feeded_var_names),
         "fetch_names": [t.name if isinstance(t, Variable) else str(t)
                         for t in target_vars],
+        "feed_meta": _feed_meta(main_program, feeded_var_names),
     }
+    if bucket_hints is not None:
+        meta["bucket_hints"] = dict(bucket_hints)
     with open(os.path.join(dirname, model_filename), "w") as f:
         json.dump(meta, f)
     save_persistables(executor, dirname, main_program)
     return pruned
 
 
-def load_inference_model(dirname, executor, model_filename="__model__"):
-    """reference: io.py:325 — returns (program, feed_names, fetch_vars)."""
+def load_inference_model(dirname, executor, model_filename="__model__",
+                         return_meta=False):
+    """reference: io.py:325 — returns (program, feed_names, fetch_vars);
+    with `return_meta`, appends the raw export metadata dict
+    (feed_meta/bucket_hints) as a fourth element."""
     with open(os.path.join(dirname, model_filename)) as f:
         meta = json.load(f)
     from ..core.desc import ProgramDesc
@@ -256,4 +285,7 @@ def load_inference_model(dirname, executor, model_filename="__model__"):
     load_vars(executor, dirname, vars=vars)
     fetch_vars = [program.global_block().var(n)
                   for n in meta["fetch_names"]]
+    if return_meta:
+        extra = {k: meta.get(k) for k in ("feed_meta", "bucket_hints")}
+        return program, meta["feed_names"], fetch_vars, extra
     return program, meta["feed_names"], fetch_vars

@@ -117,6 +117,13 @@ def batch_norm(ctx, ins, attrs):
         saved_var = use_var
 
     y = _bn_normalize(x, scale, bias, use_mean, use_var, eps, bshape)
+    # SavedVariance deliberately diverges from the reference:
+    # batch_norm_op.cc inverts it in-place to inverse-std in the
+    # forward ("SavedVariance have been reverted in forward operator")
+    # while this repo saves the RAW batch variance and lets the grad
+    # recompute rsqrt(v+eps).  batch_norm_grad's O@SavedVariance fast
+    # path depends on this repo-local convention — keep the two sites
+    # in sync if either changes.
     return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
             "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
 
@@ -155,6 +162,10 @@ def batch_norm_grad(ctx, ins, attrs):
         m = ins["Mean"][0].astype(jnp.float32)
         v = ins["Variance"][0].astype(jnp.float32)
     else:
+        # O@SavedVariance is the forward's RAW batch variance (repo
+        # convention; the reference stores inverse-std here — see the
+        # forward's save site above): the rsqrt(v+eps) below depends
+        # on it, and reference tooling reading this slot must convert
         sm = _slot0(ins, "O@SavedMean")
         sv = _slot0(ins, "O@SavedVariance")
         if sm is not None and sv is not None:

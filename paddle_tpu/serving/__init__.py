@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — online inference: bucketed compile cache,
+dynamic micro-batching, bounded admission, metrics.
+
+The offline paths (`v2/inference.py`, `fluid/io.py` prune +
+`native/capi.cc`) answer "run this batch"; this package answers "serve
+this traffic": many concurrent small requests, a compiled-shape budget,
+and a latency SLO.  The load-bearing ideas (mirroring the
+inference-accelerator deployment literature, PAPERS.md 2107.04140 /
+2607.08215):
+
+  * shape bucketing — pad every request batch up to a configured
+    bucket so the number of distinct XLA compilations is bounded and
+    warmable at startup (`engine.InferenceEngine`);
+  * dynamic micro-batching — coalesce concurrent requests up to
+    `max_batch`/`max_wait_ms` into one device launch, split results
+    back per request (`batcher.MicroBatcher`);
+  * backpressure — a bounded admission queue sheds load (429) instead
+    of queueing unboundedly, deadlines propagate so a request that can
+    no longer make its SLO is rejected, not computed
+    (`server.InferenceServer`);
+  * observability — per-stage latency histograms, queue depth, batch
+    occupancy, compile-cache hit/miss (`metrics`, `/metrics`).
+"""
+
+from .engine import InferenceEngine, EngineConfig
+from .batcher import (MicroBatcher, BatcherConfig, ServingError,
+                      QueueFullError, DeadlineExceededError,
+                      ShuttingDownError)
+from .server import InferenceServer, ServerConfig
+from . import metrics
+
+__all__ = [
+    "InferenceEngine", "EngineConfig", "MicroBatcher", "BatcherConfig",
+    "InferenceServer", "ServerConfig", "metrics", "ServingError",
+    "QueueFullError", "DeadlineExceededError", "ShuttingDownError",
+]

@@ -1,0 +1,342 @@
+"""Inference engine: a pruned Program behind a bucketed-shape compile
+cache.
+
+The executor already jit-caches per feed shape (`fluid/executor.py`
+`_CompiledProgram`), but online traffic has arbitrary per-request batch
+sizes — unbucketed, every new batch size is a fresh XLA trace+compile
+on the request path.  The engine pads every batch up to a configured
+bucket (and ragged flat token dims up to `token_bucket` multiples, the
+same scheme as `DataFeeder`), so the set of compiled shapes is small,
+known in advance, and warmable at startup: after `warmup()` no dense
+in-bucket request ever pays a compile.  Ragged feeds specialize per
+(batch bucket, token bucket, max-seqlen bucket) combination — warmup
+covers each batch bucket's smallest such shape; longer sequences still
+compile once per new token/seqlen bucket as traffic reaches them.
+
+Recompiles are *measured*, not assumed: `trace_count()` sums the jit
+specialization counts of every compiled segment, and each `run()`
+compares before/after to classify the batch as a compile-cache hit or
+miss (exposed via `metrics.cache_hit_total`/`cache_miss_total`).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from ..core.ragged import RaggedTensor
+from ..core.scope import Scope, global_scope
+from ..core.types import np_dtype
+from ..fluid import executor as executor_mod
+from ..fluid.data_feeder import DEFAULT_RAGGED_BUCKET
+
+__all__ = ["EngineConfig", "InferenceEngine"]
+
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+class EngineConfig:
+    """Shape-bucketing knobs.
+
+    batch_buckets: ascending batch sizes to pad up to; None disables
+        padding entirely (exact-shape execution, offline behavior).
+        Batches beyond the largest bucket round up to a multiple of it.
+    token_bucket: flat token-length multiple for ragged (LoD) feeds.
+    warmup_ragged: also pre-compile the ragged feed path per bucket
+        (one-token sequences); dense feeds always warm.
+    """
+
+    def __init__(self, batch_buckets=DEFAULT_BATCH_BUCKETS,
+                 token_bucket=DEFAULT_RAGGED_BUCKET, warmup_ragged=True):
+        if batch_buckets is not None:
+            batch_buckets = tuple(sorted(set(int(b) for b in
+                                             batch_buckets)))
+            if not batch_buckets or batch_buckets[0] < 1:
+                raise ValueError("batch_buckets must be positive ints")
+        self.batch_buckets = batch_buckets
+        self.token_bucket = int(token_bucket)
+        self.warmup_ragged = bool(warmup_ragged)
+
+    def bucket_for(self, batch):
+        """Smallest configured bucket >= batch (multiples of the
+        largest bucket beyond it)."""
+        if self.batch_buckets is None:
+            return batch
+        for b in self.batch_buckets:
+            if batch <= b:
+                return b
+        top = self.batch_buckets[-1]
+        return -(-batch // top) * top
+
+
+def _ragged_to_sequences(r):
+    """Host-side inverse of RaggedTensor.from_sequences (lod_level 1):
+    the per-sequence value arrays, padding rows dropped."""
+    if r.lod_level != 1:
+        raise ValueError("micro-batching supports lod_level-1 inputs; "
+                         "got lod_level=%d" % r.lod_level)
+    splits = np.asarray(r.row_splits[0])
+    values = np.asarray(r.values)
+    return [values[splits[i]:splits[i + 1]]
+            for i in range(len(splits) - 1)]
+
+
+def slice_ragged(r, nseq):
+    """First `nseq` level-0 sequences of a RaggedTensor, as a host-side
+    RaggedTensor (used to strip bucket padding from ragged fetches)."""
+    import jax.numpy as jnp
+
+    take = int(nseq)
+    out_splits = []
+    for rs in r.row_splits:
+        rs = np.asarray(rs)
+        out_splits.append(rs[:take + 1])
+        take = int(rs[take])
+    values = np.asarray(r.values)[:take]
+    return RaggedTensor(jnp.asarray(values), out_splits, nvalid=take)
+
+
+class InferenceEngine:
+    """A pruned inference Program wrapped into a bucket-padded callable
+    with its own parameter scope and executor.
+
+    Feeds accepted by `run()` (all batch-major):
+      * dense: numpy array `[B, ...]`
+      * ragged: python list of per-sequence arrays, or a lod_level-1
+        RaggedTensor (rebucketed if padding is enabled)
+    Returns fetch values sliced back to the true batch (`B` rows for
+    dense fetches, `B` sequences for ragged ones); fetches without a
+    batch-major leading dim (e.g. scalar summaries) pass through.
+    """
+
+    def __init__(self, program, feed_names, fetch_list, place=None,
+                 config=None, scope=None, metrics=None, feed_meta=None):
+        from ..fluid import framework
+
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [
+            f.name if isinstance(f, framework.Variable) else str(f)
+            for f in fetch_list]
+        self.place = place or executor_mod.CPUPlace()
+        self.config = config or EngineConfig()
+        # scope=None tracks the *current* global scope at each run
+        # (offline v2.infer semantics); pass an explicit Scope for an
+        # isolated parameter store (from_saved_model does)
+        self.scope = scope
+        self.metrics = metrics
+        self._exe = executor_mod.Executor(self.place)
+        self._lock = threading.Lock()
+        # feed_meta: the export-time metadata dict from
+        # save_inference_model (dtype as a numpy name string); absent
+        # entries fall back to the program's var descs
+        exported = feed_meta or {}
+        self._feed_meta = {}
+        for n in self.feed_names:
+            m = exported.get(n)
+            if m and m.get("dtype"):
+                self._feed_meta[n] = {
+                    "shape": list(m["shape"]),
+                    "dtype": np.dtype(m["dtype"]),
+                    "lod_level": int(m["lod_level"])}
+            else:
+                self._feed_meta[n] = self._var_meta(n)
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_saved_model(cls, dirname, place=None, config=None,
+                         metrics=None, model_filename="__model__"):
+        """Load a `save_inference_model` export into a fresh scope.
+        Bucket hints recorded at export time seed the config unless the
+        caller passes one explicitly."""
+        from ..fluid import io as fluid_io
+
+        scope = Scope()
+        exe = executor_mod.Executor(place or executor_mod.CPUPlace())
+        with executor_mod.scope_guard(scope):
+            program, feed_names, fetch_vars, extra = \
+                fluid_io.load_inference_model(
+                    dirname, exe, model_filename=model_filename,
+                    return_meta=True)
+        if config is None:
+            hints = extra.get("bucket_hints") or {}
+            config = EngineConfig(
+                batch_buckets=hints.get("batch_buckets",
+                                        DEFAULT_BATCH_BUCKETS),
+                token_bucket=hints.get("token_bucket",
+                                       DEFAULT_RAGGED_BUCKET))
+        return cls(program, feed_names, fetch_vars, place=place,
+                   config=config, scope=scope, metrics=metrics,
+                   feed_meta=extra.get("feed_meta"))
+
+    def _var_meta(self, name):
+        var = self.program.global_block().var(name)
+        return {"shape": list(var.shape), "dtype": np_dtype(var.dtype),
+                "lod_level": var.lod_level}
+
+    # -- compile-cache accounting -------------------------------------------
+    def trace_count(self):
+        """Total jit specializations across every compiled segment —
+        the ground truth for 'did that request recompile'."""
+        n = 0
+        for compiled in self._exe._cache.values():
+            for jitted in compiled._jit_cache.values():
+                size = getattr(jitted["fn"], "_cache_size", None)
+                if size is not None:
+                    n += size() or 0
+        return n
+
+    # -- padding ------------------------------------------------------------
+    def _batch_of(self, value):
+        if isinstance(value, RaggedTensor):
+            return value.nseq(0)
+        if isinstance(value, (list, tuple)):
+            return len(value)
+        shape = getattr(value, "shape", None)
+        if shape is not None:  # numpy or device array: no host copy
+            return int(shape[0])
+        return int(np.asarray(value).shape[0])
+
+    def batch_size(self, feeds):
+        sizes = {n: self._batch_of(feeds[n]) for n in self.feed_names
+                 if n in feeds}
+        if not sizes:
+            raise ValueError("feeds name none of %s" % self.feed_names)
+        if len(set(sizes.values())) != 1:
+            raise ValueError("inconsistent feed batch sizes: %r" % sizes)
+        return next(iter(sizes.values()))
+
+    def _pad_dense(self, arr, target):
+        arr = np.asarray(arr)
+        if arr.shape[0] == target:
+            return arr
+        pad = np.zeros((target - arr.shape[0],) + arr.shape[1:],
+                       arr.dtype)
+        return np.concatenate([arr, pad], axis=0)
+
+    def _pad_ragged(self, value, target, dtype):
+        seqs = (_ragged_to_sequences(value)
+                if isinstance(value, RaggedTensor) else
+                [np.asarray(s, dtype=dtype) for s in value])
+        trailing = seqs[0].shape[1:] if seqs else ()
+        # pad with one-token zero sequences (not empty ones: several
+        # sequence kernels divide by length)
+        seqs = list(seqs) + [np.zeros((1,) + tuple(trailing), dtype)
+                             for _ in range(target - len(seqs))]
+        return RaggedTensor.from_sequences(
+            seqs, dtype=dtype, bucket=self.config.token_bucket)
+
+    def pad_feeds(self, feeds, true_batch=None):
+        """Pad every feed up to the bucket for `true_batch`; returns
+        (padded_feed_dict, true_batch, bucket)."""
+        if true_batch is None:
+            true_batch = self.batch_size(feeds)
+        bucket = self.config.bucket_for(true_batch)
+        padded = {}
+        for name in self.feed_names:
+            if name not in feeds:
+                raise KeyError("missing feed %r (program expects %s)"
+                               % (name, self.feed_names))
+            value = feeds[name]
+            if self.config.batch_buckets is None:
+                # exact-shape mode: hand feeds straight through (list
+                # inputs still materialize as RaggedTensors)
+                if isinstance(value, (list, tuple)):
+                    value = self._pad_ragged(
+                        value, len(value), self._feed_meta[name]["dtype"])
+                padded[name] = value
+                continue
+            meta = self._feed_meta[name]
+            if meta["lod_level"] > 0 or isinstance(value, RaggedTensor) \
+                    or isinstance(value, (list, tuple)):
+                padded[name] = self._pad_ragged(value, bucket,
+                                                meta["dtype"])
+            else:
+                padded[name] = self._pad_dense(
+                    np.asarray(value, dtype=meta["dtype"]), bucket)
+        return padded, true_batch, bucket
+
+    def _slice_fetch(self, value, true_batch, bucket):
+        if isinstance(value, RaggedTensor):
+            if str(value.values.dtype) == "bfloat16":
+                # feed/fetch contract stays f32 (see Executor._to_numpy)
+                value = value.with_values(
+                    value.values.astype(np.float32))
+            if value.nseq(0) == bucket and true_batch < bucket:
+                return slice_ragged(value, true_batch)
+            return value
+        arr = np.asarray(value)
+        if arr.dtype.name == "bfloat16":
+            # feed/fetch contract stays f32 (see Executor._to_numpy)
+            arr = arr.astype(np.float32)
+        if arr.ndim and arr.shape[0] == bucket and true_batch < bucket:
+            return arr[:true_batch]
+        return arr
+
+    # -- execution ----------------------------------------------------------
+    def run(self, feeds, timings=None):
+        """Pad, execute, slice.  `timings`, when given, receives
+        {"pad": s, "compute": s}."""
+        import jax
+
+        with self._lock:
+            t0 = time.perf_counter()
+            padded, true_batch, bucket = self.pad_feeds(feeds)
+            t1 = time.perf_counter()
+            traces_before = self.trace_count()
+            scope = (self.scope if self.scope is not None
+                     else global_scope())
+            outs = self._exe.run(self.program, feed=padded,
+                                 fetch_list=self.fetch_names,
+                                 scope=scope, return_numpy=False)
+            jax.block_until_ready(
+                [getattr(o, "values", o) for o in outs if o is not None])
+            t2 = time.perf_counter()
+            compiled = self.trace_count() > traces_before
+        if self.metrics is not None:
+            (self.metrics.cache_miss_total if compiled
+             else self.metrics.cache_hit_total).inc()
+            self.metrics.observe_stage("pad", t1 - t0)
+            self.metrics.observe_stage("compute", t2 - t1)
+        if timings is not None:
+            timings["pad"] = t1 - t0
+            timings["compute"] = t2 - t1
+            timings["compiled"] = compiled
+        return [self._slice_fetch(o, true_batch, bucket) for o in outs]
+
+    # -- warmup -------------------------------------------------------------
+    def _synthetic_feed(self, meta, batch):
+        # non-negative dims are the per-sample (dense) / per-row
+        # (ragged values) shape — same filter as DataFeeder's
+        # _sample_shape
+        shape = tuple(s for s in meta["shape"] if s >= 0)
+        if meta["lod_level"] > 0:
+            return [np.zeros((1,) + shape, meta["dtype"])
+                    for _ in range(batch)]
+        return np.zeros((batch,) + shape, meta["dtype"])
+
+    def warmup(self):
+        """Compile every batch bucket up front with synthetic zero
+        feeds, so no dense in-bucket request pays an XLA trace (ragged
+        feeds warm only each batch bucket's smallest token/seqlen
+        shape — see the module docstring).  Returns the number of
+        buckets warmed."""
+        if self.config.batch_buckets is None:
+            return 0
+        has_ragged = any(m["lod_level"] > 0
+                         for m in self._feed_meta.values())
+        if has_ragged and not self.config.warmup_ragged:
+            return 0
+        # warmup compiles are startup cost, not traffic: keep them out
+        # of the request-path latency histograms and hit/miss counters
+        saved_metrics, self.metrics = self.metrics, None
+        warmed = 0
+        try:
+            for bucket in self.config.batch_buckets:
+                feeds = {n: self._synthetic_feed(m, bucket)
+                         for n, m in self._feed_meta.items()}
+                self.run(feeds)
+                warmed += 1
+        finally:
+            self.metrics = saved_metrics
+        return warmed

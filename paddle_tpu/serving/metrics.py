@@ -1,0 +1,225 @@
+"""Serving metrics: counters, gauges, per-stage latency histograms.
+
+Prometheus-style text exposition (`render_text`) for the server's
+`/metrics` endpoint.  Every latency observation is mirrored into
+`fluid.profiler`'s record table (`serving/<stage>` rows), so
+`fluid.profiler.profiler()` around a serving run shows queue/pad/
+compute next to the executor's jit-segment rows with no extra wiring.
+"""
+
+import threading
+import bisect
+
+from ..fluid import profiler as profiler_mod
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "ServingMetrics", "DEFAULT_LATENCY_BUCKETS"]
+
+# seconds; spans sub-ms CPU-cache hits to multi-second cold compiles
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    def __init__(self, name, help_text=""):
+        self.name = name
+        self.help_text = help_text
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, amount=1):
+        if amount < 0:
+            raise ValueError("counter %s cannot decrease" % self.name)
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def render(self):
+        return ["# TYPE %s counter" % self.name,
+                "%s %g" % (self.name, self.value)]
+
+
+class Gauge:
+    """Instantaneous value (queue depth, in-flight requests)."""
+
+    def __init__(self, name, help_text=""):
+        self.name = name
+        self.help_text = help_text
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def inc(self, amount=1):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1):
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def render(self):
+        return ["# TYPE %s gauge" % self.name,
+                "%s %g" % (self.name, self.value)]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (prometheus semantics: bucket `le`
+    counts include every observation <= bound, plus +Inf)."""
+
+    def __init__(self, name, buckets=DEFAULT_LATENCY_BUCKETS,
+                 help_text=""):
+        self.name = name
+        self.help_text = help_text
+        self.bounds = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._total = 0
+        self._max = 0.0
+
+    def observe(self, value):
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._total += 1
+            if value > self._max:
+                self._max = value
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._total
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    @property
+    def max(self):
+        with self._lock:
+            return self._max
+
+    def render(self):
+        lines = ["# TYPE %s histogram" % self.name]
+        with self._lock:
+            cum = 0
+            for bound, n in zip(self.bounds, self._counts):
+                cum += n
+                lines.append('%s_bucket{le="%g"} %d'
+                             % (self.name, bound, cum))
+            cum += self._counts[-1]
+            lines.append('%s_bucket{le="+Inf"} %d' % (self.name, cum))
+            lines.append("%s_sum %g" % (self.name, self._sum))
+            lines.append("%s_count %d" % (self.name, self._total))
+        return lines
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name, help_text=""):
+        return self.register(Counter(name, help_text))
+
+    def gauge(self, name, help_text=""):
+        return self.register(Gauge(name, help_text))
+
+    def histogram(self, name, buckets=DEFAULT_LATENCY_BUCKETS,
+                  help_text=""):
+        return self.register(Histogram(name, buckets, help_text))
+
+    def render_text(self):
+        with self._lock:
+            metrics = list(self._metrics)
+        lines = []
+        for m in metrics:
+            if m.help_text:
+                lines.append("# HELP %s %s" % (m.name, m.help_text))
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class ServingMetrics:
+    """The fixed metric set one server instance exposes."""
+
+    def __init__(self):
+        reg = self.registry = MetricsRegistry()
+        self.requests_total = reg.counter(
+            "serving_requests_total", "requests admitted to the queue")
+        self.responses_total = reg.counter(
+            "serving_responses_total", "requests answered successfully")
+        self.rejected_queue_full = reg.counter(
+            "serving_rejected_queue_full_total",
+            "requests shed because the admission queue was full")
+        self.rejected_deadline = reg.counter(
+            "serving_rejected_deadline_total",
+            "requests dropped because their deadline expired")
+        self.rejected_draining = reg.counter(
+            "serving_rejected_draining_total",
+            "requests refused during shutdown drain")
+        self.errors_total = reg.counter(
+            "serving_errors_total", "requests failed with an error")
+        self.cache_hit_total = reg.counter(
+            "serving_compile_cache_hit_total",
+            "batches whose padded shape was already compiled")
+        self.cache_miss_total = reg.counter(
+            "serving_compile_cache_miss_total",
+            "batches that triggered an XLA trace/compile")
+        self.queue_depth = reg.gauge(
+            "serving_queue_depth", "requests waiting in the admission "
+            "queue")
+        self.inflight = reg.gauge(
+            "serving_inflight_batches", "batches currently executing")
+        self.batch_occupancy = reg.histogram(
+            "serving_batch_occupancy",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+            help_text="requests coalesced per executed batch")
+        self.batch_rows = reg.histogram(
+            "serving_batch_rows",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+            help_text="sample rows per executed batch (pre-padding)")
+        self.queue_seconds = reg.histogram(
+            "serving_queue_seconds",
+            help_text="submit -> batch-assembly latency")
+        self.pad_seconds = reg.histogram(
+            "serving_pad_seconds",
+            help_text="merge + bucket-padding latency")
+        self.compute_seconds = reg.histogram(
+            "serving_compute_seconds",
+            help_text="device execution latency (blocked on results)")
+        self.total_seconds = reg.histogram(
+            "serving_total_seconds",
+            help_text="submit -> response latency")
+
+    def observe_stage(self, stage, seconds):
+        """Record a per-stage latency in both systems: the histogram
+        for /metrics scrapes and fluid.profiler for its table."""
+        hist = getattr(self, stage + "_seconds")
+        hist.observe(seconds)
+        profiler_mod.record("serving/" + stage, seconds)
+
+    def render_text(self):
+        return self.registry.render_text()

@@ -1,0 +1,216 @@
+"""Threaded HTTP front end over the engine + micro-batcher.
+
+Endpoints:
+  POST /v1/infer   {"inputs": {name: nested lists}, "timeout_ms": n}
+                   -> {"outputs": {fetch: nested lists}, "batch": B}
+  GET  /metrics    prometheus-style text exposition
+  GET  /healthz    {"status": "ok" | "draining"}
+
+Rejection contract (the backpressure surface): a full admission queue
+answers 429 immediately, an expired deadline 504, a draining server
+503 — a request is never silently hung.  `shutdown()` stops admission
+first, then drains everything already queued, then closes the
+listener, so accepted work always gets its response.
+
+Framing is HTTP/JSON rather than the length-prefixed socket RPC of
+`native/transport.cc` — same request/response discipline, but
+scrapeable and curl-able, which the /metrics endpoint needs anyway.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..core.ragged import RaggedTensor
+from .batcher import (MicroBatcher, BatcherConfig, QueueFullError,
+                      DeadlineExceededError, ShuttingDownError)
+from .metrics import ServingMetrics
+
+__all__ = ["ServerConfig", "InferenceServer"]
+
+
+class ServerConfig:
+    def __init__(self, host="127.0.0.1", port=8500, max_batch=32,
+                 max_wait_ms=5.0, queue_size=64, default_timeout_ms=None,
+                 warmup=True):
+        self.host = host
+        self.port = int(port)
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.queue_size = int(queue_size)
+        self.default_timeout_ms = default_timeout_ms
+        self.warmup = bool(warmup)
+
+
+def _to_list(arr):
+    arr = np.asarray(arr)
+    if arr.dtype.name in ("bfloat16", "float16") \
+            or arr.dtype.kind not in "biuf":
+        arr = arr.astype(np.float32)
+    return arr.tolist()
+
+
+def _jsonable(value):
+    if isinstance(value, RaggedTensor):
+        from .engine import _ragged_to_sequences
+
+        return [_to_list(s) for s in _ragged_to_sequences(value)]
+    return _to_list(value)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one handler thread per connection (ThreadingHTTPServer); all
+    # state lives on self.server.owner
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _reply(self, status, body, content_type="application/json"):
+        data = (json.dumps(body) if content_type == "application/json"
+                else body).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        owner = self.server.owner
+        if self.path == "/metrics":
+            self._reply(200, owner.metrics.render_text(),
+                        content_type="text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            self._reply(200, {"status": "draining" if owner.draining
+                              else "ok"})
+        else:
+            self._reply(404, {"error": "not found"})
+
+    def do_POST(self):
+        owner = self.server.owner
+        if self.path not in ("/v1/infer", "/infer"):
+            self._reply(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, TypeError) as exc:
+            self._reply(400, {"error": "bad json: %s" % exc})
+            return
+        status, body = owner.handle_infer(payload)
+        self._reply(status, body)
+
+
+class InferenceServer:
+    """Owns the engine, batcher, metrics, and the HTTP listener."""
+
+    def __init__(self, engine, config=None, metrics=None):
+        self.engine = engine
+        self.config = config or ServerConfig()
+        self.metrics = metrics or ServingMetrics()
+        if engine.metrics is None:
+            engine.metrics = self.metrics
+        self.batcher = MicroBatcher(
+            engine,
+            BatcherConfig(
+                max_batch=self.config.max_batch,
+                max_wait_ms=self.config.max_wait_ms,
+                queue_size=self.config.queue_size,
+                default_timeout_ms=self.config.default_timeout_ms),
+            metrics=self.metrics)
+        self.draining = False
+        self._httpd = None
+        self._http_thread = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self.config.warmup:
+            self.engine.warmup()
+        self.batcher.start()
+        self._httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+        self._http_thread.start()
+        return self
+
+    @property
+    def address(self):
+        if self._httpd is None:
+            return (self.config.host, self.config.port)
+        return self._httpd.server_address[:2]
+
+    def shutdown(self, timeout=30.0):
+        """Graceful drain: refuse new work, answer everything already
+        admitted, then close the listener."""
+        self.draining = True
+        self.batcher.close(timeout=timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._http_thread.join(timeout=timeout)
+            self._httpd.server_close()
+
+    # -- request handling ---------------------------------------------------
+    def _parse_inputs(self, payload):
+        inputs = payload.get("inputs")
+        if not isinstance(inputs, dict):
+            raise ValueError('payload needs an "inputs" object')
+        feeds = {}
+        for name in self.engine.feed_names:
+            if name not in inputs:
+                raise ValueError("missing input %r (expected %s)"
+                                 % (name, self.engine.feed_names))
+            meta = self.engine._feed_meta[name]
+            value = inputs[name]
+            if meta["lod_level"] > 0:
+                feeds[name] = [np.asarray(s, dtype=meta["dtype"])
+                               for s in value]
+                for s in feeds[name]:
+                    self._check_tail(name, s.shape[1:], meta)
+            else:
+                feeds[name] = np.asarray(value, dtype=meta["dtype"])
+                self._check_tail(name, feeds[name].shape[1:], meta)
+        return feeds
+
+    @staticmethod
+    def _check_tail(name, tail, meta):
+        """Reject shape mismatches at admission: a malformed request
+        that reached the batcher would fail merge/concat there and
+        take every innocently co-batched request down with it."""
+        want = [s for s in meta["shape"][1:]]
+        if len(tail) != len(want) or any(
+                w >= 0 and t != w for t, w in zip(tail, want)):
+            raise ValueError(
+                "input %r has per-sample shape %s, model expects %s"
+                % (name, list(tail), want))
+
+    def handle_infer(self, payload):
+        """(status, json body) for one inference payload — shared by
+        the HTTP handler and in-process callers/tests."""
+        if self.draining:
+            self.metrics.rejected_draining.inc()
+            return 503, {"error": "draining"}
+        try:
+            feeds = self._parse_inputs(payload)
+            timeout_ms = payload.get("timeout_ms")
+            outs = self.batcher.submit_and_wait(feeds,
+                                                timeout_ms=timeout_ms)
+        except QueueFullError as exc:
+            return 429, {"error": str(exc)}
+        except DeadlineExceededError as exc:
+            return 504, {"error": str(exc)}
+        except ShuttingDownError as exc:
+            return 503, {"error": str(exc)}
+        except (ValueError, KeyError, TypeError) as exc:
+            return 400, {"error": str(exc)}
+        except Exception as exc:  # noqa: BLE001 — server must answer
+            return 500, {"error": "%s: %s" % (type(exc).__name__, exc)}
+        outputs = {name: _jsonable(val) for name, val in
+                   zip(self.engine.fetch_names, outs)}
+        return 200, {"outputs": outputs,
+                     "batch": self.engine.batch_size(feeds)}

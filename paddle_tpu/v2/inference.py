@@ -13,7 +13,8 @@ __all__ = ["infer", "Inference"]
 
 
 class Inference:
-    def __init__(self, output_layer, parameters=None):
+    def __init__(self, output_layer, parameters=None,
+                 batch_buckets=None):
         self._outputs = (output_layer if isinstance(output_layer,
                                                     (list, tuple))
                          else [output_layer])
@@ -42,6 +43,28 @@ class Inference:
                     used.update(ns)
         self._used_inputs = used
         self._exe = fluid.Executor(_place())
+        # the non-beam forward path runs through the serving engine
+        # (one code path for offline infer() and the online server);
+        # batch_buckets=None keeps exact-shape offline semantics,
+        # passing buckets turns on the padded compile cache
+        self._batch_buckets = batch_buckets
+        self._engines = {}  # frozenset(feed names) -> InferenceEngine
+
+    def _engine_for(self, feeds):
+        """Lazily wrap the pruned program in a serving engine keyed on
+        the actual feed slots (known only once `feeding` arrives).
+        One engine per feed-name set, so alternating feedings keep
+        their executors' compile caches."""
+        from ..serving.engine import InferenceEngine, EngineConfig
+
+        key = frozenset(feeds)
+        engine = self._engines.get(key)
+        if engine is None:
+            engine = self._engines[key] = InferenceEngine(
+                self._program, sorted(feeds), list(self._outputs),
+                place=_place(),
+                config=EngineConfig(batch_buckets=self._batch_buckets))
+        return engine
 
     def _feed(self, input, feeding):
         data_layers = [
@@ -62,9 +85,8 @@ class Inference:
                          field="value"):
         if self._beam_spec is not None:
             return self._run_generation(input, feeding, field)
-        outs = self._exe.run(self._program, feed=self._feed(input,
-                                                            feeding),
-                             fetch_list=list(self._outputs))
+        feeds = self._feed(input, feeding)
+        outs = self._engine_for(feeds).run(feeds)
         arrays = [np.asarray(getattr(o, "values", o)) for o in outs]
         fields = field if isinstance(field, (list, tuple)) else [field]
         for f in fields:
@@ -106,8 +128,10 @@ class Inference:
 
 
 def infer(output_layer, parameters=None, input=None, feeding=None,
-          field="value"):
-    results = Inference(output_layer, parameters).iter_infer_field(
+          field="value", batch_buckets=None):
+    results = Inference(
+        output_layer, parameters,
+        batch_buckets=batch_buckets).iter_infer_field(
         input, feeding=feeding, field=field)
     if isinstance(field, (list, tuple)):
         return results

@@ -21,7 +21,12 @@ echo "[ci] native runtime build ..."
 make -C native
 
 echo "[ci] full test suite (examples run for real, small shapes) ..."
+# tier-1 includes tests/test_serving.py (engine/batcher/server, not
+# slow-marked)
 RUN_EXAMPLES=1 python -m pytest tests/ -q
+
+echo "[ci] serving selftest (server up, one request, /metrics, drain) ..."
+timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
 
 echo "[ci] driver entry points ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \

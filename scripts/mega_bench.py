@@ -22,11 +22,18 @@ Usage:  python scripts/mega_bench.py            # everything
         MEGA_CONFIGS=f32act,fused python ...    # subset
 A config is skipped when BENCH_LAST_TPU.json already holds a record
 for it newer than MEGA_FRESH_SINCE (default: this round's start).
+
+Known-pathological legs (RISKY, e.g. the GoogLeNet inception wedge)
+run behind a per-leg subprocess guard: MEGA_LEG_TIMEOUT seconds
+(default 2400, 0 disables) and a killed leg is recorded in the BENCH
+json as {"skipped": "compile-timeout"} instead of forfeiting the whole
+TPU window.  MEGA_SUBPROC=all extends the guard to every leg.
 """
 
 import gc
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -79,6 +86,11 @@ _MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",
             "BENCH_AMP", "FLAGS_amp_bf16_act", "FLAGS_fuse_optimizer",
             "FLAGS_bn_shifted_stats")
 
+# legs whose single huge graph has wedged the remote compile service
+# (sweep 1: googlenet >40 min, killed): run these behind the
+# subprocess guard so a hang forfeits the leg, never the whole window
+RISKY = {"googlenet", "infer-googlenet"}
+
 
 def _store():
     try:
@@ -91,6 +103,56 @@ def _store():
 def _fresh_records(since):
     return {k for k, r in _store().items()
             if r.get("measured_at", 0) >= since}
+
+
+def _persist_skip(name, reason):
+    """Record a skipped leg in the BENCH json so the round's artifact
+    says WHY a row is missing instead of looking unmeasured."""
+    try:
+        with open(bench._LAST_TPU_PATH) as f:
+            store = json.load(f)
+    except (OSError, ValueError):
+        store = {}
+    store["%s|skipped" % name] = {
+        "metric": name, "skipped": reason, "measured_at": time.time()}
+    # atomic replace, same as bench._persist_tpu_record: this runs
+    # exactly when the window is misbehaving, and a kill mid-write
+    # must not truncate the round's measured records
+    tmp = bench._LAST_TPU_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(store, f, indent=1, sort_keys=True)
+    os.replace(tmp, bench._LAST_TPU_PATH)
+
+
+def run_one_guarded(name, overrides, timeout):
+    """Run one leg in a subprocess with a hard wall-clock bound
+    (subprocess guard like bench.py:115's claim probe): a pathological
+    compile is killed and recorded as skipped, and only this leg's
+    measurement is lost.  The child persists its own records to
+    BENCH_LAST_TPU.json, so the parent's freshness check still sees
+    them."""
+    env = dict(os.environ)
+    for k in _MANAGED:
+        env.pop(k, None)
+    env.update(overrides)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "bench.py"], cwd=repo,
+                            env=env)
+    try:
+        rc = proc.wait(timeout=timeout)
+        return "ok" if rc == 0 else "failed"
+    except subprocess.TimeoutExpired:
+        # same caveat as the claim probe: a child wedged in compile can
+        # survive kill() in uninterruptible I/O — never wait unbounded
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        print("[mega] %s SKIPPED: exceeded %ds leg budget"
+              % (name, timeout), flush=True)
+        _persist_skip(name, "compile-timeout")
+        return "skipped"
 
 
 def run_one(name, overrides):
@@ -139,10 +201,26 @@ def main():
     except (OSError, ValueError):
         done = {}
 
-    import jax
+    # hard wall-clock bound per guarded leg; 0 disables the guard (all
+    # legs stay in-process, the pre-guard behavior)
+    leg_timeout = float(os.environ.get("MEGA_LEG_TIMEOUT", "2400"))
+    guard_all = os.environ.get("MEGA_SUBPROC") == "all"
 
-    print("[mega] claiming: %s" % jax.devices(), flush=True)
-    ok = skipped = failed = 0
+    # claim lazily, only when an IN-PROCESS leg actually runs: a
+    # guarded leg's bench.py child makes its own claim, and on an
+    # exclusive-claim runtime a parent already holding the chip would
+    # wedge every child (bench.py:115's probe runs before any parent
+    # claim for the same reason)
+    claimed = []
+
+    def claim():
+        if not claimed:
+            import jax
+
+            print("[mega] claiming: %s" % jax.devices(), flush=True)
+            claimed.append(True)
+
+    ok = skipped = failed = timed_out = 0
     for name, overrides in CONFIGS:
         if names is not None and name not in names:
             continue
@@ -153,7 +231,15 @@ def main():
         before = _fresh_records(since)
         t0 = time.perf_counter()
         print("[mega] --- %s ---" % name, flush=True)
-        if run_one(name, overrides):
+        if leg_timeout > 0 and (guard_all or name in RISKY):
+            status = run_one_guarded(name, overrides, leg_timeout)
+        else:
+            claim()
+            status = "ok" if run_one(name, overrides) else "failed"
+        if status == "skipped":
+            timed_out += 1
+            continue
+        if status == "ok":
             gained = _fresh_records(since) - before
             if gained:
                 ok += 1
@@ -172,8 +258,9 @@ def main():
                       flush=True)
         else:
             failed += 1
-    print("[mega] done: %d measured, %d no-record, %d failed"
-          % (ok, skipped, failed), flush=True)
+    print("[mega] done: %d measured, %d no-record, %d failed, "
+          "%d compile-timeout" % (ok, skipped, failed, timed_out),
+          flush=True)
 
 
 if __name__ == "__main__":

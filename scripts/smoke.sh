@@ -19,6 +19,9 @@ python -c "import paddle_tpu; import __graft_entry__; print('  ok:', len(paddle_
 echo "[smoke] bench.py (1 iter, tiny shapes, AMP ON — the driver default) ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 python bench.py
 
+echo "[smoke] serving selftest (server up, one request, /metrics, drain) ..."
+timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
+
 echo "[smoke] dryrun_multichip(8) ..."
 # Simulate the driver env exactly: JAX_PLATFORMS points at the real TPU
 # and the function itself must bootstrap the virtual CPU mesh.  timeout

@@ -42,6 +42,7 @@ setup(
     entry_points={
         "console_scripts": [
             "paddle_trainer=paddle_tpu.tools.trainer_cli:main",
+            "paddle_serve=paddle_tpu.tools.serve_cli:main",
         ],
     },
 )

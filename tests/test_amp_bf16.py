@@ -267,8 +267,14 @@ def test_bn_backward_reuses_forward_statistics():
                  "y": jnp.zeros((4, 1), jnp.int32)}
         jaxpr = str(jax.make_jaxpr(lambda s, f: fp(s, f))(state, feeds))
     per_channel = len(re.findall(r"axes=\(0, 2, 3\)", jaxpr))
-    assert per_channel == 5, (
-        "expected 5 per-channel reductions (2 fwd stats + 2 bwd sums "
-        "+ conv bias grad), found %d — batch_norm_grad is re-sweeping "
-        "the activation instead of reusing saved statistics"
-        % per_channel)
+    # Upper bound only: the exact count (5 = 2 fwd stats + 2 bwd sums
+    # + conv bias grad) is brittle against unrelated ops and jaxpr
+    # printing changes; the lower bound (grad actually READS the saved
+    # slots rather than recomputing) is pinned by the dedicated
+    # slot-read unit test (test_conv_norm_ops.py
+    # test_bn_grad_reads_saved_stats_slot).
+    assert per_channel <= 5, (
+        "expected at most 5 per-channel reductions (2 fwd stats + "
+        "2 bwd sums + conv bias grad), found %d — batch_norm_grad is "
+        "re-sweeping the activation instead of reusing saved "
+        "statistics" % per_channel)
