@@ -15,8 +15,9 @@ from .reader.decorator import batch
 __version__ = "0.1.0"
 
 __all__ = ["reader", "dataset", "batch", "fluid", "v2", "infer",
-           "layer", "image"]
+           "layer", "image", "obs"]
 
+from . import obs  # noqa: E402
 from . import fluid  # noqa: E402
 from . import v2  # noqa: E402
 from .v2 import layer  # noqa: E402

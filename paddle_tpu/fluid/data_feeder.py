@@ -63,6 +63,11 @@ class _SlotBatch:
     def _to_device(self, arr):
         import jax
 
+        from ..obs import telemetry as obs_tele
+
+        # this device_put is the h2d transfer for feeder-built batches
+        # (the executor skips counting pre-placed jax.Array feeds)
+        obs_tele.on_transfer("h2d", getattr(arr, "nbytes", 0))
         return jax.device_put(arr, self.place.device())
 
     def done(self):

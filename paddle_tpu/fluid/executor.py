@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from ..core.scope import Scope, global_scope
 from ..core.ragged import RaggedTensor, SelectedRows
 from ..core.types import np_dtype, VarType
+from ..obs import telemetry as obs_tele
+from ..obs import trace as obs_trace
 from ..ops import registry as op_registry
 from ..utils import flags
 from . import framework
@@ -370,7 +372,9 @@ class _CompiledProgram:
                 for od in seg["ops"]:
                     # per-op attribution like the reference interpreter
                     # (reference: executor.cc:126-127 RecordEvent per op,
-                    # executor.cc:29+66-77 FLAGS_check_nan_inf scan)
+                    # executor.cc:29+66-77 FLAGS_check_nan_inf scan);
+                    # record_event is span-backed: rows land in the
+                    # profiler table AND on the obs trace timeline
                     with profiler_mod.record_event(od.type):
                         outs = apply_op(ctx, od)
                     if flags.get_flag("check_nan_inf"):
@@ -401,6 +405,8 @@ class _CompiledProgram:
         first_call = i not in self._jit_cache
         jitted = self._jit_cache.get(i)
         if jitted is None:
+            obs_trace.instant("jit_build", cat="compile",
+                              segment=self._segment_label(i, seg))
             ops = seg["ops"]
             out_names = tuple(seg["outputs"])
             program = self.program
@@ -426,15 +432,28 @@ class _CompiledProgram:
         mutated = jitted["mutated"]
         mut_ins = {n: v for n, v in in_vals.items() if n in mutated}
         ro_ins = {n: v for n, v in in_vals.items() if n not in mutated}
-        if not profiler_mod.is_enabled():
+        size_fn = getattr(jitted["fn"], "_cache_size", lambda: None)
+        profiled = profiler_mod.is_enabled()
+        tracing = obs_trace.is_enabled()
+        if not (profiled or tracing):
+            # hot path: dispatch async; compile detection stays on (a
+            # retrace is the single costliest event, telemetry must see
+            # it even unprofiled) — _cache_size is a cheap int read
+            pre_traces = size_fn()
             outs, rng = jitted["fn"](mut_ins, ro_ins, rng_state)
+            post_traces = size_fn()
+            if first_call or (pre_traces is not None
+                              and post_traces is not None
+                              and post_traces > pre_traces):
+                obs_tele.on_jit_trace(self._segment_label(i, seg))
             return outs, rng
-        # profiled: block on the segment's outputs so the wall time is
-        # the device time, not just the dispatch (ParseEvents analog for
-        # the compiled path; per-op rows come from eager mode).  A trace
-        # hit (new shapes/dtypes) also lands in the /first(trace) row.
+        # profiled/traced: block on the segment's outputs so the wall
+        # time is the device time, not just the dispatch (ParseEvents
+        # analog for the compiled path; per-op rows come from eager
+        # mode).  A trace hit (new shapes/dtypes) also lands in the
+        # /first(trace) row and as a jit_trace instant on the timeline.
         label = self._segment_label(i, seg)
-        pre_traces = getattr(jitted["fn"], "_cache_size", lambda: None)()
+        pre_traces = size_fn()
         t0 = time.perf_counter()
         outs, rng = jitted["fn"](mut_ins, ro_ins, rng_state)
         jax.block_until_ready((outs, rng))
@@ -442,8 +461,16 @@ class _CompiledProgram:
         traced = first_call or (
             pre_traces is not None
             and jitted["fn"]._cache_size() > pre_traces)
-        profiler_mod.record(
-            label + ("/first(trace)" if traced else ""), dt)
+        if traced:
+            obs_tele.on_jit_trace(label)
+        if tracing:
+            obs_trace.emit_span("executor/" + label, t0, dt,
+                                cat="executor",
+                                args={"traced": traced} if traced
+                                else None)
+        if profiled:
+            profiler_mod.record(
+                label + ("/first(trace)" if traced else ""), dt)
         return outs, rng
 
 
@@ -492,33 +519,40 @@ class Executor:
         fetch_names = [f.name if isinstance(f, framework.Variable) else str(f)
                        for f in fetch_list]
 
-        feed_env = {}
-        block0 = program.desc.block(0)
-        for name, val in feed.items():
-            feed_env[name] = self._prepare_feed(block0, name, val)
+        obs_tele.on_executor_run()
+        run_span = obs_trace.span("executor/run", cat="executor",
+                                  feeds=len(feed),
+                                  fetches=len(fetch_names))
+        with run_span:
+            feed_env = {}
+            block0 = program.desc.block(0)
+            for name, val in feed.items():
+                feed_env[name] = self._prepare_feed(block0, name, val)
 
-        # dtype policy is trace-time state: a flipped amp flag must not
-        # reuse executables traced under the old policy
-        key = (program._cache_token, program.version, 0,
-               tuple(sorted(feed_env.keys())), tuple(fetch_names),
-               flags.get_flag("amp_bf16"), flags.get_flag("amp_bf16_act"),
-               flags.get_flag("bn_shifted_stats"))
-        compiled = self._cache.get(key) if use_program_cache else None
-        if compiled is None:
-            compiled = _CompiledProgram(self, program, 0,
-                                        sorted(feed_env.keys()), fetch_names)
-            if use_program_cache:
-                self._cache[key] = compiled
-                while len(self._cache) > self._CACHE_MAX:
-                    self._cache.popitem(last=False)
-        elif use_program_cache:
-            self._cache.move_to_end(key)
+            # dtype policy is trace-time state: a flipped amp flag must
+            # not reuse executables traced under the old policy
+            key = (program._cache_token, program.version, 0,
+                   tuple(sorted(feed_env.keys())), tuple(fetch_names),
+                   flags.get_flag("amp_bf16"),
+                   flags.get_flag("amp_bf16_act"),
+                   flags.get_flag("bn_shifted_stats"))
+            compiled = self._cache.get(key) if use_program_cache else None
+            if compiled is None:
+                compiled = _CompiledProgram(self, program, 0,
+                                            sorted(feed_env.keys()),
+                                            fetch_names)
+                if use_program_cache:
+                    self._cache[key] = compiled
+                    while len(self._cache) > self._CACHE_MAX:
+                        self._cache.popitem(last=False)
+            elif use_program_cache:
+                self._cache.move_to_end(key)
 
-        results = compiled.run(scope, feed_env, eager=eager)
+            results = compiled.run(scope, feed_env, eager=eager)
 
-        if return_numpy:
-            results = [self._to_numpy(r) for r in results]
-        return results
+            if return_numpy:
+                results = [self._to_numpy(r) for r in results]
+            return results
 
     def _prepare_feed(self, block_desc, name, val):
         if isinstance(val, (RaggedTensor, SelectedRows)):
@@ -553,6 +587,10 @@ class Executor:
             arr = arr.astype(np_dtype(vd.dtype), copy=False)
         elif arr.dtype == np.int64:
             arr = arr.astype(np.int32)
+        # host->device feed cost, made visible instead of inferred from
+        # step-time noise (pre-placed jax.Array feeds above moved
+        # nothing and are not counted)
+        obs_tele.on_transfer("h2d", arr.nbytes)
         return jax.device_put(arr, self.place.device())
 
     @staticmethod
@@ -563,6 +601,8 @@ class Executor:
             if r.values.dtype == jnp.bfloat16:
                 r = r.with_values(r.values.astype(jnp.float32))
             return r
+        if isinstance(r, jax.Array):
+            obs_tele.on_transfer("d2h", r.size * r.dtype.itemsize)
         arr = np.asarray(r)
         if arr.dtype == jnp.bfloat16:
             # bf16 is an internal compute dtype (FLAGS_amp_bf16_act);

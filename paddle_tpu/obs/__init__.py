@@ -1,0 +1,33 @@
+"""paddle_tpu.obs — the unified observability layer.
+
+One place for the three signals every perf/serving PR reads
+(reference: paddle/platform/profiler.h:27-146 wraps every op in a
+RecordEvent and parses one global event table — here the same idea is
+split into composable pieces instead of one table):
+
+  * `trace`    — thread-safe span tracer with Chrome trace-event JSON
+                 export (load the file in Perfetto / chrome://tracing).
+                 The executor, both trainer stacks, the parallel layer
+                 and the serving engine/batcher all emit spans into it.
+  * `registry` — central counter/gauge/histogram registry with labeled
+                 metrics, Prometheus-text and JSONL export.
+                 `serving/metrics.py` is a thin shim over it, and the
+                 serving `/metrics` endpoint serves the unified view.
+  * `telemetry`— step-level training telemetry (step time,
+                 examples/sec, jit trace/compile counts, host<->device
+                 transfer bytes, loss / loss-scale / grad-norm gauges)
+                 built on the two above.
+
+Everything is import-cheap and off by default: with tracing disabled a
+span is one attribute load + one `is` check, and registry counters are
+plain locked adds — safe on the executor hot path.
+
+`python -m paddle_tpu.tools.obs_dump --selftest` exercises the whole
+layer end to end (see docs/OBSERVABILITY.md).
+"""
+
+from . import trace
+from . import registry
+from . import telemetry
+
+__all__ = ["trace", "registry", "telemetry"]

@@ -1,0 +1,168 @@
+"""Step-level run telemetry on top of obs.trace + obs.registry.
+
+What the training/serving loops report here (and what every perf PR
+reads back):
+
+  * executor:  run counts, jit trace/compile detections (with instant
+               trace events so a Perfetto timeline shows WHERE the
+               stall was), host<->device transfer bytes from the
+               feed/fetch paths — the costs that are otherwise
+               *inferred* from step-time noise.
+  * trainers:  per-step wall time, examples/sec, steps, last loss —
+               one labeled metric family shared by the v2 SGD loop and
+               the mesh-parallel trainer (`trainer` label).
+  * scalars:   loss-scale / grad-norm style gauges via `set_gauge`.
+
+Everything funnels into the default registry (`obs.registry`), so one
+Prometheus scrape / `obs_dump` call sees executor, trainer and serving
+metrics side by side.  All helpers are cheap enough to call
+unconditionally: a counter inc is one dict lookup + locked add.
+"""
+
+import time
+
+from . import registry as registry_mod
+from . import trace as trace_mod
+
+__all__ = ["on_executor_run", "on_jit_trace", "on_transfer",
+           "jit_trace_count", "transfer_bytes", "step", "set_gauge",
+           "snapshot"]
+
+# histogram bounds for step wall time: sub-ms tiny CPU steps up to
+# multi-second compile-included first steps
+STEP_SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _reg():
+    return registry_mod.get_registry()
+
+
+# ---------------------------------------------------------------------------
+# executor-side hooks
+# ---------------------------------------------------------------------------
+
+def on_executor_run():
+    """One Executor.run() dispatch (any program)."""
+    _reg().counter("executor_runs_total",
+                   "Executor.run() invocations").inc()
+
+
+def on_jit_trace(label):
+    """A jitted segment specialized (traced + compiled) — the event
+    that turns into a multi-second stall on TPU.  Counted per segment
+    label and marked on the trace timeline."""
+    _reg().counter("executor_jit_traces_total",
+                   "XLA trace/compile events detected across jitted "
+                   "segments").inc()
+    trace_mod.instant("jit_trace", cat="compile", label=label)
+
+
+def jit_trace_count():
+    return _reg().counter("executor_jit_traces_total",
+                          "XLA trace/compile events detected across "
+                          "jitted segments").value
+
+
+def on_transfer(direction, nbytes):
+    """Host<->device bytes moved by the executor feed/fetch paths.
+    direction: "h2d" (feeds placed on device) or "d2h" (fetches pulled
+    to host)."""
+    if nbytes:
+        _reg().counter("executor_transfer_bytes_total",
+                       "host<->device bytes moved by executor "
+                       "feed/fetch", labelnames=("direction",)) \
+              .labels(direction=direction).inc(int(nbytes))
+
+
+def transfer_bytes(direction):
+    fam = _reg().counter("executor_transfer_bytes_total",
+                         "host<->device bytes moved by executor "
+                         "feed/fetch", labelnames=("direction",))
+    return fam.labels(direction=direction).value
+
+
+# ---------------------------------------------------------------------------
+# trainer-side hooks
+# ---------------------------------------------------------------------------
+
+class _StepTimer:
+    """Times one training step; on exit feeds the trainer metric
+    family and leaves a `<trainer>/step` span on the trace."""
+
+    __slots__ = ("trainer", "examples", "args", "_t0")
+
+    def __init__(self, trainer, examples, args):
+        self.trainer = trainer
+        self.examples = examples
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t0 = self._t0
+        dt = time.perf_counter() - t0
+        trace_mod.emit_span(self.trainer + "/step", t0, dt,
+                            cat="trainer", args=self.args)
+        if exc_type is not None:
+            return False
+        reg = _reg()
+        reg.counter("trainer_steps_total", "completed train steps",
+                    labelnames=("trainer",)) \
+           .labels(trainer=self.trainer).inc()
+        reg.histogram("trainer_step_seconds", STEP_SECONDS_BUCKETS,
+                      "train step wall time",
+                      labelnames=("trainer",)) \
+           .labels(trainer=self.trainer).observe(dt)
+        if self.examples:
+            reg.counter("trainer_examples_total",
+                        "examples consumed by train steps",
+                        labelnames=("trainer",)) \
+               .labels(trainer=self.trainer).inc(self.examples)
+            if dt > 0:
+                reg.gauge("trainer_examples_per_sec",
+                          "throughput of the most recent step",
+                          labelnames=("trainer",)) \
+                   .labels(trainer=self.trainer) \
+                   .set(self.examples / dt)
+        return False
+
+
+def step(trainer, examples=None, **args):
+    """`with telemetry.step("v2", examples=len(batch)): run_step()` —
+    times the step, feeds the trainer metrics, emits a span."""
+    return _StepTimer(trainer, examples, args or None)
+
+
+def set_gauge(name, value, **labels):
+    """Set a named gauge (loss, loss scale, grad norm, ...).  Labeled
+    when label kwargs are given."""
+    reg = _reg()
+    if labels:
+        g = reg.gauge(name, labelnames=tuple(sorted(labels)))
+        g.labels(**labels).set(value)
+    else:
+        reg.gauge(name).set(value)
+
+
+def snapshot():
+    """Flat {metric_name or name{labels}: value} view of the default
+    registry (histograms contribute _count/_sum) — for embedding
+    registry state into artifacts or asserting on it in tests.
+    (mega_bench's BENCH "metrics" blob is a hand-built
+    {wall_s, jit_traces} subset, not this.)"""
+    flat = {}
+    for s in _reg().to_dict()["metrics"]:
+        key = s["name"]
+        labels = s.get("labels")
+        if labels:
+            key += "{%s}" % ",".join(
+                "%s=%s" % (k, v) for k, v in sorted(labels.items()))
+        if s["type"] == "histogram":
+            flat[key + "_count"] = s["count"]
+            flat[key + "_sum"] = round(s["sum"], 6)
+        else:
+            flat[key] = s["value"]
+    return flat

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs import trace as obs_trace
 from .sharding import shard_map_norep
 
 __all__ = ["gpipe_spmd", "pipeline_apply", "split_microbatches",
@@ -72,7 +73,11 @@ def gpipe_spmd(stage_fn, stacked_params, x_mb, axis_name="pp"):
         inj = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, m - 1), 0,
                                        keepdims=False)
         cur = jnp.where(idx == 0, inj, state)
-        y = stage_fn(local, cur)
+        # named_scope threads the stage region through to HLO metadata,
+        # so a device profile (jax.profiler / Perfetto) attributes time
+        # to the pipeline stage instead of an anonymous fusion
+        with jax.named_scope("pp_stage"):
+            y = stage_fn(local, cur)
         # the last stage finishes microbatch t-(s-1) at tick t
         o_idx = jnp.clip(t - (s - 1), 0, m - 1)
         valid = jnp.logical_and(idx == s - 1, t >= s - 1)
@@ -116,6 +121,13 @@ def pipeline_apply(mesh, stage_fn, stacked_params, x, n_microbatches,
         functools.partial(gpipe_spmd, fn, axis_name=axis_name),
         mesh=mesh, in_specs=(param_specs, x_spec), out_specs=x_spec)
 
-    x_mb = split_microbatches(x, n_microbatches)
-    out_mb = mapped(stacked_params, x_mb)
+    # host-side span over the whole pipelined dispatch; per-stage
+    # attribution inside the scan comes from the pp_stage named_scope
+    # (device timeline), since the schedule itself is one traced scan
+    with obs_trace.span("parallel/pipeline_apply", cat="parallel",
+                        stages=int(s), microbatches=int(n_microbatches)):
+        x_mb = split_microbatches(x, n_microbatches)
+        out_mb = mapped(stacked_params, x_mb)
+        if obs_trace.is_enabled():
+            jax.block_until_ready(out_mb)
     return out_mb.reshape((-1,) + out_mb.shape[2:])

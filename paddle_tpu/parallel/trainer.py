@@ -14,6 +14,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..jit import FunctionalProgram, state_from_scope
+from ..obs import telemetry as obs_tele
 from .sharding import (param_spec, batch_spec, is_optimizer_state,
                        optimizer_state_names, zero1_spec)
 
@@ -135,12 +136,24 @@ class ParallelTrainer:
 
     def step(self, feeds):
         rng = jax.random.fold_in(self._base_rng, self._step_count)
+        step_id = self._step_count
         self._step_count += 1
         feeds = {n: jnp_asarray(v) for n, v in feeds.items()}
-        # trace under the mesh context so mesh-aware op kernels (ring
-        # flash_attention) see the sp topology
-        with self.mesh:
-            fetches, self.state = self._step_fn(self.state, feeds, rng)
+        examples = next((int(v.shape[0]) for v in feeds.values()
+                         if getattr(v, "ndim", 0)), None)
+        # step telemetry into the unified registry + a parallel/step
+        # span; block on the fetches so trainer_step_seconds is device
+        # time, never just the async dispatch (~µs).  Fetches are the
+        # replicated loss/metric scalars every caller reads right
+        # after, and new_state materializes in the same executable, so
+        # this costs the host-side feed-prep overlap only.
+        with obs_tele.step("parallel", examples=examples, step=step_id):
+            # trace under the mesh context so mesh-aware op kernels
+            # (ring flash_attention) see the sp topology
+            with self.mesh:
+                fetches, self.state = self._step_fn(self.state, feeds,
+                                                    rng)
+            jax.block_until_ready(fetches)
         return fetches
 
     def fetch_state(self, name):

@@ -20,6 +20,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..core.ragged import RaggedTensor
+from ..obs import trace as obs_trace
 from .engine import _ragged_to_sequences
 
 __all__ = ["BatcherConfig", "MicroBatcher", "ServingError",
@@ -287,7 +288,10 @@ class MicroBatcher:
             self.metrics.batch_rows.observe(sum(r.batch for r in live))
             self.metrics.inflight.inc()
         try:
-            outs = self.engine.run(self._merge_feeds(live))
+            with obs_trace.span("serving/batch", cat="serving",
+                                occupancy=len(live),
+                                rows=sum(r.batch for r in live)):
+                outs = self.engine.run(self._merge_feeds(live))
             offsets = np.cumsum([0] + [r.batch for r in live])[:-1]
             per_fetch = [self._split_fetch(o, offsets, live)
                          for o in outs]

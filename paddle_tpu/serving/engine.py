@@ -279,7 +279,10 @@ class InferenceEngine:
         {"pad": s, "compute": s}."""
         import jax
 
-        with self._lock:
+        from ..obs import trace as obs_trace
+
+        with self._lock, obs_trace.span("serving/engine_run",
+                                        cat="serving") as run_span:
             t0 = time.perf_counter()
             padded, true_batch, bucket = self.pad_feeds(feeds)
             t1 = time.perf_counter()
@@ -293,6 +296,8 @@ class InferenceEngine:
                 [getattr(o, "values", o) for o in outs if o is not None])
             t2 = time.perf_counter()
             compiled = self.trace_count() > traces_before
+            run_span.set(batch=true_batch, bucket=bucket,
+                         compiled=compiled)
         if self.metrics is not None:
             (self.metrics.cache_miss_total if compiled
              else self.metrics.cache_hit_total).inc()

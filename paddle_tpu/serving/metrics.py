@@ -1,165 +1,25 @@
 """Serving metrics: counters, gauges, per-stage latency histograms.
 
-Prometheus-style text exposition (`render_text`) for the server's
-`/metrics` endpoint.  Every latency observation is mirrored into
-`fluid.profiler`'s record table (`serving/<stage>` rows), so
-`fluid.profiler.profiler()` around a serving run shows queue/pad/
-compute next to the executor's jit-segment rows with no extra wiring.
+Since the obs layer landed this module is a thin shim over
+`paddle_tpu.obs.registry` — the metric classes and
+`DEFAULT_LATENCY_BUCKETS` are re-exported from there (same names, same
+render format), and `ServingMetrics` keeps its fixed metric set but
+also mounts itself into the process-wide default registry, so the
+server's `/metrics` endpoint and `obs_dump` serve executor, trainer
+and serving metrics from ONE surface.
+
+Every latency observation is still mirrored into `fluid.profiler`'s
+record table (`serving/<stage>` rows), so `fluid.profiler.profiler()`
+around a serving run shows queue/pad/compute next to the executor's
+jit-segment rows with no extra wiring.
 """
 
-import threading
-import bisect
-
 from ..fluid import profiler as profiler_mod
+from ..obs.registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                            DEFAULT_LATENCY_BUCKETS, get_registry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "ServingMetrics", "DEFAULT_LATENCY_BUCKETS"]
-
-# seconds; spans sub-ms CPU-cache hits to multi-second cold compiles
-DEFAULT_LATENCY_BUCKETS = (
-    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0)
-
-
-class Counter:
-    """Monotonically increasing count."""
-
-    def __init__(self, name, help_text=""):
-        self.name = name
-        self.help_text = help_text
-        self._lock = threading.Lock()
-        self._value = 0
-
-    def inc(self, amount=1):
-        if amount < 0:
-            raise ValueError("counter %s cannot decrease" % self.name)
-        with self._lock:
-            self._value += amount
-
-    @property
-    def value(self):
-        with self._lock:
-            return self._value
-
-    def render(self):
-        return ["# TYPE %s counter" % self.name,
-                "%s %g" % (self.name, self.value)]
-
-
-class Gauge:
-    """Instantaneous value (queue depth, in-flight requests)."""
-
-    def __init__(self, name, help_text=""):
-        self.name = name
-        self.help_text = help_text
-        self._lock = threading.Lock()
-        self._value = 0
-
-    def set(self, value):
-        with self._lock:
-            self._value = value
-
-    def inc(self, amount=1):
-        with self._lock:
-            self._value += amount
-
-    def dec(self, amount=1):
-        with self._lock:
-            self._value -= amount
-
-    @property
-    def value(self):
-        with self._lock:
-            return self._value
-
-    def render(self):
-        return ["# TYPE %s gauge" % self.name,
-                "%s %g" % (self.name, self.value)]
-
-
-class Histogram:
-    """Cumulative-bucket histogram (prometheus semantics: bucket `le`
-    counts include every observation <= bound, plus +Inf)."""
-
-    def __init__(self, name, buckets=DEFAULT_LATENCY_BUCKETS,
-                 help_text=""):
-        self.name = name
-        self.help_text = help_text
-        self.bounds = tuple(sorted(buckets))
-        self._lock = threading.Lock()
-        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
-        self._sum = 0.0
-        self._total = 0
-        self._max = 0.0
-
-    def observe(self, value):
-        idx = bisect.bisect_left(self.bounds, value)
-        with self._lock:
-            self._counts[idx] += 1
-            self._sum += value
-            self._total += 1
-            if value > self._max:
-                self._max = value
-
-    @property
-    def count(self):
-        with self._lock:
-            return self._total
-
-    @property
-    def sum(self):
-        with self._lock:
-            return self._sum
-
-    @property
-    def max(self):
-        with self._lock:
-            return self._max
-
-    def render(self):
-        lines = ["# TYPE %s histogram" % self.name]
-        with self._lock:
-            cum = 0
-            for bound, n in zip(self.bounds, self._counts):
-                cum += n
-                lines.append('%s_bucket{le="%g"} %d'
-                             % (self.name, bound, cum))
-            cum += self._counts[-1]
-            lines.append('%s_bucket{le="+Inf"} %d' % (self.name, cum))
-            lines.append("%s_sum %g" % (self.name, self._sum))
-            lines.append("%s_count %d" % (self.name, self._total))
-        return lines
-
-
-class MetricsRegistry:
-    def __init__(self):
-        self._metrics = []
-        self._lock = threading.Lock()
-
-    def register(self, metric):
-        with self._lock:
-            self._metrics.append(metric)
-        return metric
-
-    def counter(self, name, help_text=""):
-        return self.register(Counter(name, help_text))
-
-    def gauge(self, name, help_text=""):
-        return self.register(Gauge(name, help_text))
-
-    def histogram(self, name, buckets=DEFAULT_LATENCY_BUCKETS,
-                  help_text=""):
-        return self.register(Histogram(name, buckets, help_text))
-
-    def render_text(self):
-        with self._lock:
-            metrics = list(self._metrics)
-        lines = []
-        for m in metrics:
-            if m.help_text:
-                lines.append("# HELP %s %s" % (m.name, m.help_text))
-            lines.extend(m.render())
-        return "\n".join(lines) + "\n"
 
 
 class ServingMetrics:
@@ -213,6 +73,10 @@ class ServingMetrics:
         self.total_seconds = reg.histogram(
             "serving_total_seconds",
             help_text="submit -> response latency")
+        # newest instance owns the unified registry's "serving" group
+        # (tests build many instances per process; last one wins, each
+        # keeps its own `registry` intact either way)
+        get_registry().attach("serving", reg)
 
     def observe_stage(self, stage, seconds):
         """Record a per-stage latency in both systems: the histogram
@@ -222,4 +86,9 @@ class ServingMetrics:
         profiler_mod.record("serving/" + stage, seconds)
 
     def render_text(self):
-        return self.registry.render_text()
+        """The UNIFIED exposition: executor/trainer/profiler metrics
+        from the default registry plus this instance's serving metrics
+        (overriding whatever instance currently holds the "serving"
+        mount, so a scrape of an older server stays self-consistent)."""
+        return get_registry().render_text(
+            override_groups={"serving": self.registry})

@@ -1,0 +1,279 @@
+"""Dump/export paddle_tpu observability state: Chrome trace-event JSON
+(Perfetto-loadable) and the unified metrics registry.
+
+    # validate a trace file someone handed you:
+    python -m paddle_tpu.tools.obs_dump --check trace.json
+
+    # the CI entry point (scripts/ci.sh, scripts/smoke.sh):
+    python -m paddle_tpu.tools.obs_dump --selftest
+
+    # IN-PROCESS, at the end of a run you instrumented with
+    # obs.trace.tracing() (trace/registry state lives in the process
+    # that ran the workload — a fresh shell invocation has nothing to
+    # dump and says so):
+    from paddle_tpu.tools import obs_dump
+    obs_dump.main(["--trace-out", "trace.json",
+                   "--metrics-out", "metrics.prom"])
+
+`--selftest` runs a tiny REAL workload under tracing — a v2 SGD
+trainer (executor underneath) plus a serving InferenceEngine request
+pair (compile miss + cache hit) — then asserts the exported trace is
+valid Chrome trace-event JSON with nested executor/trainer spans and
+that ONE registry render carries executor, trainer and serving
+metrics.  See docs/OBSERVABILITY.md for naming conventions.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_obs_dump")
+    p.add_argument("--trace-out", default=None,
+                   help="write the collected trace as Chrome "
+                        "trace-event JSON")
+    p.add_argument("--metrics-out", default=None,
+                   help="write the unified metrics registry ('-' for "
+                        "stdout)")
+    p.add_argument("--format", choices=("prom", "jsonl"),
+                   default="prom",
+                   help="metrics format: Prometheus text or JSONL")
+    p.add_argument("--check", default=None, metavar="TRACE_JSON",
+                   help="validate an existing Chrome trace file and "
+                        "exit")
+    p.add_argument("--selftest", action="store_true",
+                   help="run a tiny traced workload and assert the "
+                        "whole obs pipeline works end to end")
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------------
+# validation helpers (also used by tests)
+# ---------------------------------------------------------------------------
+
+def validate_chrome_trace(doc):
+    """Assert `doc` (dict or path) is a loadable Chrome trace-event
+    document; returns the traceEvents list."""
+    if not isinstance(doc, dict):
+        with open(doc) as f:
+            doc = json.load(f)
+    events = doc.get("traceEvents")
+    assert isinstance(events, list) and events, \
+        "traceEvents missing or empty"
+    for ev in events:
+        assert isinstance(ev.get("name"), str), ev
+        assert ev.get("ph") in ("X", "B", "E", "i", "M", "C"), ev
+        if ev["ph"] in ("X", "B", "E", "i"):
+            assert isinstance(ev.get("ts"), (int, float)), ev
+            assert "pid" in ev and "tid" in ev, ev
+        if ev["ph"] == "X":
+            assert isinstance(ev.get("dur"), (int, float)), ev
+    return events
+
+
+def validate_prometheus_text(text):
+    """Assert every exposition line parses as comment or
+    `name[{labels}] value`; returns the set of metric names seen."""
+    names = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        body, _, value = line.rpartition(" ")
+        assert body, "unparseable line: %r" % line
+        float(value)  # raises if the sample value isn't numeric
+        name = body.split("{", 1)[0]
+        assert name and " " not in name, "bad metric name: %r" % line
+        names.add(name)
+    assert names, "no metric samples in exposition"
+    return names
+
+
+def _find_span(events, prefix):
+    return [ev for ev in events
+            if ev["ph"] == "X" and ev["name"].startswith(prefix)]
+
+
+def _nested_within(outer, inner):
+    return (outer["tid"] == inner["tid"]
+            and outer["ts"] <= inner["ts"] + 1e-3
+            and inner["ts"] + inner.get("dur", 0)
+            <= outer["ts"] + outer["dur"] + 1e-3)
+
+
+# ---------------------------------------------------------------------------
+# selftest workload
+# ---------------------------------------------------------------------------
+
+def _train_tiny_v2():
+    """Three SGD steps through the real v2 trainer (executor + jit
+    segments underneath)."""
+    import numpy as np
+
+    import paddle_tpu.v2 as paddle
+
+    paddle.init()
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y",
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=0.1))
+    rs = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(3):
+            yield [(rs.rand(4).astype("f"), rs.rand(1).astype("f"))
+                   for _ in range(4)]
+
+    trainer.train(reader=reader, num_passes=1,
+                  feeding={"x": 0, "y": 1})
+
+
+def _serve_tiny():
+    """One compile-miss and one cache-hit request through the serving
+    engine, with ServingMetrics mounted on the unified registry."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.serving import InferenceEngine, EngineConfig
+    from paddle_tpu.serving.metrics import ServingMetrics
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+        probs = fluid.layers.fc(input=img, size=3, act="softmax")
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    program = fluid_io.prune_program(main, [probs])
+    metrics = ServingMetrics()
+    engine = InferenceEngine(
+        program, ["img"], [probs], scope=scope, metrics=metrics,
+        config=EngineConfig(batch_buckets=[2, 4]))
+    engine.run({"img": np.zeros((2, 8), np.float32)})  # miss: compile
+    engine.run({"img": np.ones((1, 8), np.float32)})   # same bucket: hit
+    assert metrics.cache_miss_total.value >= 1
+    assert metrics.cache_hit_total.value >= 1
+    return metrics
+
+
+def selftest(args):
+    # the selftest must never contend for a real accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.obs import telemetry as obs_tele
+    from paddle_tpu.obs import trace as obs_trace
+
+    obs_trace.enable(clear=True)
+    try:
+        _train_tiny_v2()
+        metrics = _serve_tiny()
+    finally:
+        obs_trace.disable()
+
+    # --- trace side: valid Chrome JSON, nested executor+trainer spans
+    trace_path = args.trace_out or os.path.join(
+        tempfile.mkdtemp(prefix="paddle_obs_"), "trace.json")
+    obs_trace.export_chrome_trace(trace_path)
+    events = validate_chrome_trace(trace_path)
+    steps = _find_span(events, "v2/step")
+    runs = _find_span(events, "executor/run")
+    segs = _find_span(events, "executor/jit_segment")
+    serving_spans = _find_span(events, "serving/engine_run")
+    assert steps, "no trainer spans in trace"
+    assert runs, "no executor spans in trace"
+    assert segs, "no jit-segment spans in trace"
+    assert serving_spans, "no serving spans in trace"
+    assert any(_nested_within(st, r) for st in steps for r in runs), \
+        "executor/run span not nested inside a v2/step span"
+    assert any(_nested_within(r, sg) for r in runs for sg in segs), \
+        "jit-segment span not nested inside an executor/run span"
+
+    # --- metrics side: ONE registry render carries all three layers
+    text = metrics.render_text()  # unified render via ServingMetrics
+    names = validate_prometheus_text(text)
+    for needed in ("executor_runs_total", "executor_jit_traces_total",
+                   "trainer_steps_total", "trainer_step_seconds",
+                   "serving_compile_cache_miss_total",
+                   "serving_compile_cache_hit_total"):
+        # histograms expose only _bucket/_sum/_count sample names
+        assert any(n == needed or n.startswith(needed + "_")
+                   for n in names), \
+            "%s missing from unified exposition:\n%s" % (needed, text)
+    assert obs_tele.jit_trace_count() > 0
+    assert obs_tele.transfer_bytes("h2d") > 0
+
+    # the same data is exportable as JSONL for offline diffing
+    jsonl = obs_registry.get_registry().render_jsonl()
+    for line in jsonl.strip().splitlines():
+        json.loads(line)
+
+    if args.metrics_out:
+        _write_metrics(args, text if args.format == "prom" else jsonl)
+    print("[obs] selftest green: %d trace events (%d trainer steps, "
+          "%d executor runs, %d jit segments, %d serving spans), "
+          "unified /metrics has %d metric families, trace at %s"
+          % (len(events), len(steps), len(runs), len(segs),
+             len(serving_spans), len(names), trace_path), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# plain dump modes
+# ---------------------------------------------------------------------------
+
+def _write_metrics(args, payload):
+    if args.metrics_out == "-":
+        sys.stdout.write(payload)
+        return
+    with open(args.metrics_out, "w") as f:
+        f.write(payload)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.selftest:
+        return selftest(args)
+    if args.check:
+        events = validate_chrome_trace(args.check)
+        print("[obs] %s: valid Chrome trace with %d events"
+              % (args.check, len(events)), flush=True)
+        return 0
+    if not args.trace_out and not args.metrics_out:
+        raise SystemExit("nothing to do: pass --selftest, --check, "
+                         "--trace-out and/or --metrics-out")
+    from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.obs import trace as obs_trace
+
+    if args.trace_out:
+        doc = obs_trace.export_chrome_trace(args.trace_out)
+        n = sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+        print("[obs] wrote trace: %s (%d events)%s"
+              % (args.trace_out, n,
+                 "" if n else " — EMPTY: dump modes export THIS "
+                 "process's state; call obs_dump.main() in-process "
+                 "after obs.trace.tracing()"), flush=True)
+    if args.metrics_out:
+        reg = obs_registry.get_registry()
+        _write_metrics(args, reg.render_text() if args.format == "prom"
+                       else reg.render_jsonl())
+        if args.metrics_out != "-":
+            print("[obs] wrote metrics: %s" % args.metrics_out,
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

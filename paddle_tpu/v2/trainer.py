@@ -7,6 +7,7 @@ import numpy as np
 
 from .. import fluid
 from ..fluid import framework
+from ..obs import telemetry as obs_tele
 from . import event as v2_event
 from . import layer as v2_layer
 from .config import _place
@@ -87,10 +88,16 @@ class SGD:
             pass_costs = []
             for batch_id, data in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                outs = self._exe.run(self._main_program,
-                                     feed=feeder.feed(data),
-                                     fetch_list=fetch)
+                # step telemetry: wall time + examples/sec into the
+                # unified registry, a v2/step span on the trace
+                with obs_tele.step("v2", examples=len(data),
+                                   pass_id=pass_id, batch_id=batch_id):
+                    outs = self._exe.run(self._main_program,
+                                         feed=feeder.feed(data),
+                                         fetch_list=fetch)
                 cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                obs_tele.set_gauge("trainer_last_loss", cost,
+                                   trainer="v2")
                 pass_costs.append(cost)
                 event_handler(v2_event.EndForwardBackward(
                     pass_id, batch_id))

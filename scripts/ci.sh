@@ -28,6 +28,9 @@ RUN_EXAMPLES=1 python -m pytest tests/ -q
 echo "[ci] serving selftest (server up, one request, /metrics, drain) ..."
 timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
 
+echo "[ci] obs selftest (traced train+serve, Perfetto JSON, unified /metrics) ..."
+timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
+
 echo "[ci] driver entry points ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
     python bench.py

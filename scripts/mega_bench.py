@@ -28,6 +28,11 @@ run behind a per-leg subprocess guard: MEGA_LEG_TIMEOUT seconds
 (default 2400, 0 disables) and a killed leg is recorded in the BENCH
 json as {"skipped": "compile-timeout"} instead of forfeiting the whole
 TPU window.  MEGA_SUBPROC=all extends the guard to every leg.
+
+Every leg's wall/compile timings flow through the paddle_tpu.obs
+registry (mega_leg_wall_seconds / mega_leg_jit_traces, labeled by
+leg) and are stamped into the leg's BENCH_LAST_TPU.json records as a
+"metrics" blob, so a round's artifact carries its own timing context.
 """
 
 import gc
@@ -105,6 +110,45 @@ def _fresh_records(since):
             if r.get("measured_at", 0) >= since}
 
 
+def _attach_metrics(keys, blob):
+    """Stamp each freshly-persisted BENCH record with the leg's
+    observability blob (wall/compile timings from paddle_tpu.obs), so
+    the round's artifact carries its own measurement context."""
+    if not blob:
+        return
+    try:
+        with open(bench._LAST_TPU_PATH) as f:
+            store = json.load(f)
+    except (OSError, ValueError):
+        return
+    changed = False
+    for k in keys:
+        if k in store:
+            store[k]["metrics"] = blob
+            changed = True
+    if not changed:
+        return
+    tmp = bench._LAST_TPU_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(store, f, indent=1, sort_keys=True)
+    os.replace(tmp, bench._LAST_TPU_PATH)
+
+
+def _leg_registry_emit(name, wall_s, jit_traces=None):
+    """Each leg's wall/compile timings also land in the unified obs
+    registry (labeled by leg), scrapeable by obs_dump after a suite."""
+    from paddle_tpu.obs import registry as obs_registry
+
+    reg = obs_registry.get_registry()
+    reg.gauge("mega_leg_wall_seconds",
+              "wall time of the most recent run of each bench leg",
+              labelnames=("leg",)).labels(leg=name).set(round(wall_s, 3))
+    if jit_traces is not None:
+        reg.gauge("mega_leg_jit_traces",
+                  "executor jit trace/compile events during each leg",
+                  labelnames=("leg",)).labels(leg=name).set(jit_traces)
+
+
 def _persist_skip(name, reason):
     """Record a skipped leg in the BENCH json so the round's artifact
     says WHY a row is missing instead of looking unmeasured."""
@@ -136,11 +180,18 @@ def run_one_guarded(name, overrides, timeout):
         env.pop(k, None)
     env.update(overrides)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
     proc = subprocess.Popen([sys.executable, "bench.py"], cwd=repo,
                             env=env)
     try:
         rc = proc.wait(timeout=timeout)
-        return "ok" if rc == 0 else "failed"
+        wall = time.perf_counter() - t0
+        # child-process legs report wall only (the child's obs
+        # registry dies with it; its record still gets the blob)
+        _leg_registry_emit(name, wall)
+        if rc == 0:
+            return "ok", {"wall_s": round(wall, 3)}
+        return "failed", None
     except subprocess.TimeoutExpired:
         # same caveat as the claim probe: a child wedged in compile can
         # survive kill() in uninterruptible I/O — never wait unbounded
@@ -152,11 +203,15 @@ def run_one_guarded(name, overrides, timeout):
         print("[mega] %s SKIPPED: exceeded %ds leg budget"
               % (name, timeout), flush=True)
         _persist_skip(name, "compile-timeout")
-        return "skipped"
+        return "skipped", None
 
 
 def run_one(name, overrides):
+    """Run one leg in-process.  Returns the leg's metrics blob on
+    success (wall time + executor jit trace/compile count, both also
+    emitted through the obs registry), None on failure."""
     from paddle_tpu.fluid import amp
+    from paddle_tpu.obs import telemetry as obs_tele
     from paddle_tpu.utils import flags
 
     saved = {k: os.environ.get(k) for k in _MANAGED}
@@ -168,14 +223,19 @@ def run_one(name, overrides):
         if "FLAGS_" + k not in overrides:
             flags.set_flag(k, flags._FLAGS[k]["default"])
     amp.disable_bf16()           # bench.main re-enables unless AMP=0
+    traces_before = obs_tele.jit_trace_count()
+    t0 = time.perf_counter()
     try:
         bench.main()
-        return True
+        wall = time.perf_counter() - t0
+        jit_traces = obs_tele.jit_trace_count() - traces_before
+        _leg_registry_emit(name, wall, jit_traces)
+        return {"wall_s": round(wall, 3), "jit_traces": jit_traces}
     except BaseException as e:   # noqa: BLE001 — keep measuring
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             raise
         print("[mega] %s FAILED: %r" % (name, e), flush=True)
-        return False
+        return None
     finally:
         for k, v in saved.items():
             if v is None:
@@ -232,15 +292,17 @@ def main():
         t0 = time.perf_counter()
         print("[mega] --- %s ---" % name, flush=True)
         if leg_timeout > 0 and (guard_all or name in RISKY):
-            status = run_one_guarded(name, overrides, leg_timeout)
+            status, blob = run_one_guarded(name, overrides, leg_timeout)
         else:
             claim()
-            status = "ok" if run_one(name, overrides) else "failed"
+            blob = run_one(name, overrides)
+            status = "ok" if blob is not None else "failed"
         if status == "skipped":
             timed_out += 1
             continue
         if status == "ok":
             gained = _fresh_records(since) - before
+            _attach_metrics(gained, blob)
             if gained:
                 ok += 1
                 done[name] = time.time()

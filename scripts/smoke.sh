@@ -22,6 +22,9 @@ BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 python bench.py
 echo "[smoke] serving selftest (server up, one request, /metrics, drain) ..."
 timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
 
+echo "[smoke] obs selftest (traced train+serve, Perfetto JSON, unified /metrics) ..."
+timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
+
 echo "[smoke] dryrun_multichip(8) ..."
 # Simulate the driver env exactly: JAX_PLATFORMS points at the real TPU
 # and the function itself must bootstrap the virtual CPU mesh.  timeout
