@@ -4,7 +4,43 @@ from . import framework
 
 __all__ = ["GradientClipByValue", "GradientClipByNorm",
            "GradientClipByGlobalNorm", "ErrorClipByValue",
-           "append_gradient_clip_ops", "error_clip_callback"]
+           "append_gradient_clip_ops", "append_global_norm",
+           "error_clip_callback"]
+
+
+def append_global_norm(block, var_list, squared=False, prefix="global_norm"):
+    """Append ops computing sqrt(sum(||v||^2 for v in var_list)) and
+    return the scalar norm Variable.
+
+    The global-norm recipe shared by GradientClipByGlobalNorm and the
+    numerics health monitor (obs/health.py `grad_global_norm` gauge).
+    `squared=True` means var_list already holds per-tensor squared
+    norms (the clipper's process_context phase builds them itself)."""
+    if not var_list:
+        raise ValueError("append_global_norm needs at least one var")
+    first = var_list[0]
+    dtype = getattr(first, "dtype", "float32")
+    if squared:
+        sq_vars = list(var_list)
+    else:
+        sq_vars = []
+        for v in var_list:
+            sq = block.create_var(
+                name=framework.unique_name(prefix + "_sq"),
+                dtype=dtype, shape=(1,))
+            block.append_op(type="squared_l2_norm", inputs={"X": [v]},
+                            outputs={"Out": [sq]})
+            sq_vars.append(sq)
+    gsum = block.create_var(
+        name=framework.unique_name(prefix + "_sumsq"),
+        dtype=dtype, shape=(1,))
+    block.append_op(type="sum", inputs={"X": sq_vars},
+                    outputs={"Out": [gsum]})
+    gnorm = block.create_var(
+        name=framework.unique_name(prefix), dtype=dtype, shape=(1,))
+    block.append_op(type="sqrt", inputs={"X": [gsum]},
+                    outputs={"Out": [gnorm]})
+    return gnorm
 
 
 class BaseErrorClipAttr:
@@ -95,16 +131,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         group = self.context[self.group_name]
         if not isinstance(group[-1], tuple):
             # first call after process_context phase: build the global scale
-            gsum = block.create_var(
-                name=framework.unique_name("global_norm_sq"),
-                dtype=grad.dtype, shape=(1,))
-            block.append_op(type="sum", inputs={"X": group},
-                            outputs={"Out": [gsum]})
-            gnorm = block.create_var(
-                name=framework.unique_name("global_norm"),
-                dtype=grad.dtype, shape=(1,))
-            block.append_op(type="sqrt", inputs={"X": [gsum]},
-                            outputs={"Out": [gnorm]})
+            gnorm = append_global_norm(block, group, squared=True)
             # scale = clip_norm / max(gnorm, clip_norm): never divides by
             # zero and caps at 1 (reference clip.py GradientClipByGlobalNorm)
             denom = block.create_var(

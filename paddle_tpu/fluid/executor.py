@@ -14,6 +14,12 @@ into maximal jittable segments, preserving the reference's interleaved
 semantics.  An eager per-op mode (`run(..., eager=True)`) reproduces the
 reference's interpreter for debugging, per-op profiling and nan checks
 (reference: executor.cc:29 FLAGS_check_nan_inf).
+
+FLAGS_check_nan_inf scans ONLY the eager path — a jitted segment never
+sees the flag.  For compiled programs use `paddle_tpu.obs.health`:
+`NumericsMonitor` keeps on-device nonfinite/grad-norm counters inside
+the jitted step, and `locate_nonfinite(program, feed)` replays a bad
+step eagerly to name the first offending op (docs/OBSERVABILITY.md).
 """
 
 import time
@@ -26,6 +32,8 @@ import jax.numpy as jnp
 from ..core.scope import Scope, global_scope
 from ..core.ragged import RaggedTensor, SelectedRows
 from ..core.types import np_dtype, VarType
+from ..obs import flight as obs_flight
+from ..obs import health as obs_health
 from ..obs import telemetry as obs_tele
 from ..obs import trace as obs_trace
 from ..ops import registry as op_registry
@@ -34,24 +42,51 @@ from . import framework
 from . import profiler as profiler_mod
 
 
+class NonfiniteError(FloatingPointError):
+    """Raised by the eager FLAGS_check_nan_inf scan, carrying the
+    identity of the first offending op so `obs.health.locate_nonfinite`
+    can report it structurally (op_index is annotated by the eager
+    interpreter loop)."""
+
+    def __init__(self, message, op_type=None, slot=None, var_name=None,
+                 op_index=None, nonfinite_count=None):
+        super().__init__(message)
+        self.op_type = op_type
+        self.slot = slot
+        self.var_name = var_name
+        self.op_index = op_index
+        self.nonfinite_count = nonfinite_count
+
+
 def _check_outputs_finite(op_desc, outs):
     """Eager-mode NaN/Inf scan of op outputs (reference: executor.cc:29
-    FLAGS_check_nan_inf + CheckTensorNANOrInf executor.cc:66-77)."""
-    for slot, vals in (outs or {}).items():
-        for val in (vals or []):
+    FLAGS_check_nan_inf + CheckTensorNANOrInf executor.cc:66-77).
+
+    NOTE: only the EAGER interpreter runs this scan — a jitted segment
+    never sees the flag (scanning inside a trace would force per-op
+    device->host syncs and defeat XLA fusion).  For compiled programs,
+    use `paddle_tpu.obs.health`: `NumericsMonitor` for always-on
+    on-device nonfinite counters, `locate_nonfinite(program, feed)` to
+    replay a bad step eagerly and name the first offending op."""
+    for slot, names in (op_desc.outputs or {}).items():
+        vals = (outs or {}).get(slot) or []
+        for name, val in zip(names, vals):
             arr = getattr(val, "values", val)
             if arr is None or not hasattr(arr, "dtype"):
                 continue
             if not np.issubdtype(np.dtype(arr.dtype), np.floating):
                 continue
             host = np.asarray(arr)  # one device->host copy per output
-            if not np.all(np.isfinite(host)):
-                raise FloatingPointError(
-                    "NaN/Inf in output slot %r of op %r"
-                    % (slot, op_desc.type))
+            bad = int(host.size - np.isfinite(host).sum())
+            if bad:
+                raise NonfiniteError(
+                    "%d NaN/Inf element(s) in output %r (slot %r) of "
+                    "op %r" % (bad, name, slot, op_desc.type),
+                    op_type=op_desc.type, slot=slot, var_name=name,
+                    nonfinite_count=bad)
 
 __all__ = ["Executor", "Place", "CPUPlace", "TPUPlace", "CUDAPlace",
-           "global_scope", "scope_guard", "fetch_var"]
+           "NonfiniteError", "global_scope", "scope_guard", "fetch_var"]
 
 RNG_STATE_NAME = "@RNG_STATE@"
 
@@ -378,7 +413,17 @@ class _CompiledProgram:
                     with profiler_mod.record_event(od.type):
                         outs = apply_op(ctx, od)
                     if flags.get_flag("check_nan_inf"):
-                        _check_outputs_finite(od, outs)
+                        try:
+                            _check_outputs_finite(od, outs)
+                        except NonfiniteError as err:
+                            # annotate the block-wide op position (error
+                            # path only; list.index is identity-based)
+                            try:
+                                err.op_index = self.program.desc.block(
+                                    self.block_idx).ops.index(od)
+                            except ValueError:
+                                pass
+                            raise
                 rng_state = ctx.rng
                 out_vals = {n: ctx.env[n] for n in seg["outputs"]
                             if n in ctx.env}
@@ -446,6 +491,10 @@ class _CompiledProgram:
                               and post_traces is not None
                               and post_traces > pre_traces):
                 obs_tele.on_jit_trace(self._segment_label(i, seg))
+            if first_call:
+                self._capture_xla_cost(jitted["fn"],
+                                       self._segment_label(i, seg),
+                                       (mut_ins, ro_ins, rng_state))
             return outs, rng
         # profiled/traced: block on the segment's outputs so the wall
         # time is the device time, not just the dispatch (ParseEvents
@@ -471,7 +520,31 @@ class _CompiledProgram:
         if profiled:
             profiler_mod.record(
                 label + ("/first(trace)" if traced else ""), dt)
+        if first_call:
+            self._capture_xla_cost(jitted["fn"], label,
+                                   (mut_ins, ro_ins, rng_state))
         return outs, rng
+
+    @staticmethod
+    def _capture_xla_cost(fn, label, args):
+        """Best-effort per-segment memory/FLOP attribution at jit-build
+        time (FLAGS_xla_cost_attribution): `fn.lower(...).compile()`
+        then `compiled.memory_analysis()/cost_analysis()` land in the
+        `xla_*{segment=...}` gauges.  The AOT path does NOT share the
+        jit call path's executable cache (measured, jax 0.4.37), so
+        this re-runs the XLA compile — roughly doubling a segment's
+        first-build cost — which is why the flag defaults off and only
+        startup-budget surfaces (serving warmup, bench legs that can
+        afford it) enable it.  Runtimes that expose neither analysis
+        are skipped silently."""
+        if not (flags.get_flag("xla_cost_attribution")
+                or obs_health.attribution_forced()):
+            return
+        try:
+            compiled = fn.lower(*args).compile()
+        except Exception:
+            return  # lowering the aval signature failed: skip quietly
+        obs_health.publish_compile_stats(label, compiled)
 
 
 # ---------------------------------------------------------------------------
@@ -523,6 +596,21 @@ class Executor:
         run_span = obs_trace.span("executor/run", cat="executor",
                                   feeds=len(feed),
                                   fetches=len(fetch_names))
+        try:
+            return self._run_traced(run_span, program, feed, fetch_names,
+                                    scope, return_numpy,
+                                    use_program_cache, eager)
+        except Exception as exc:
+            # flight-recorder hook: a crashing run leaves a post-mortem
+            # bundle (no-op unless obs.flight.install() was called)
+            obs_flight.on_crash(
+                exc, origin="executor/run",
+                feeds=obs_flight.describe_feeds(feed),
+                fetches=list(fetch_names), eager=bool(eager))
+            raise
+
+    def _run_traced(self, run_span, program, feed, fetch_names, scope,
+                    return_numpy, use_program_cache, eager):
         with run_span:
             feed_env = {}
             block0 = program.desc.block(0)

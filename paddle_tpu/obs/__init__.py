@@ -17,10 +17,19 @@ split into composable pieces instead of one table):
                  examples/sec, jit trace/compile counts, host<->device
                  transfer bytes, loss / loss-scale / grad-norm gauges)
                  built on the two above.
+  * `health`   — numerics health: jit-safe NaN/Inf + grad-norm
+                 monitoring (`NumericsMonitor`), the eager bisection
+                 `locate_nonfinite`, and per-segment XLA memory/cost
+                 attribution gauges (`xla_*`).
+  * `flight`   — crash flight recorder: a bounded ring of structured
+                 step records dumped as a JSON post-mortem bundle from
+                 executor/trainer/serving exception paths and an
+                 excepthook (`obs_dump --flight` renders one).
 
 Everything is import-cheap and off by default: with tracing disabled a
-span is one attribute load + one `is` check, and registry counters are
-plain locked adds — safe on the executor hot path.
+span is one attribute load + one `is` check, registry counters are
+plain locked adds, and the health/flight hooks start with a single
+flag/None check — safe on the executor hot path.
 
 `python -m paddle_tpu.tools.obs_dump --selftest` exercises the whole
 layer end to end (see docs/OBSERVABILITY.md).
@@ -29,5 +38,7 @@ layer end to end (see docs/OBSERVABILITY.md).
 from . import trace
 from . import registry
 from . import telemetry
+from . import health
+from . import flight
 
-__all__ = ["trace", "registry", "telemetry"]
+__all__ = ["trace", "registry", "telemetry", "health", "flight"]

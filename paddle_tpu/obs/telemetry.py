@@ -26,7 +26,7 @@ from . import trace as trace_mod
 
 __all__ = ["on_executor_run", "on_jit_trace", "on_transfer",
            "jit_trace_count", "transfer_bytes", "step", "set_gauge",
-           "snapshot"]
+           "snapshot", "snapshot_delta", "snapshot_and_delta"]
 
 # histogram bounds for step wall time: sub-ms tiny CPU steps up to
 # multi-second compile-included first steps
@@ -147,22 +147,62 @@ def set_gauge(name, value, **labels):
         reg.gauge(name).set(value)
 
 
-def snapshot():
-    """Flat {metric_name or name{labels}: value} view of the default
-    registry (histograms contribute _count/_sum) — for embedding
-    registry state into artifacts or asserting on it in tests.
-    (mega_bench's BENCH "metrics" blob is a hand-built
-    {wall_s, jit_traces} subset, not this.)"""
-    flat = {}
+def _flat_samples():
+    """One (key, sample) pair per registry sample, with the
+    `name{k=v,...}` key convention shared by snapshot/snapshot_delta
+    (kept in ONE place so the two views can't drift apart)."""
     for s in _reg().to_dict()["metrics"]:
         key = s["name"]
         labels = s.get("labels")
         if labels:
             key += "{%s}" % ",".join(
                 "%s=%s" % (k, v) for k, v in sorted(labels.items()))
+        yield key, s
+
+
+def snapshot():
+    """Flat {metric_name or name{labels}: value} view of the default
+    registry (histograms contribute _count/_sum) — for embedding
+    registry state into artifacts or asserting on it in tests.  This
+    is the flight recorder's per-step delta base, and
+    `snapshot_delta` over it is mega_bench's per-leg BENCH "metrics"
+    blob, so those artifacts carry the full registry (including the
+    per-segment xla_* memory/cost gauges)."""
+    return snapshot_and_delta({})[0]
+
+
+def snapshot_and_delta(before):
+    """(snapshot(), snapshot_delta(before)) from ONE registry walk —
+    for per-step callers (the flight recorder) that need both the new
+    baseline and the movement and shouldn't serialize the registry
+    twice per training step."""
+    snap, delta = {}, {}
+    for key, s in _flat_samples():
         if s["type"] == "histogram":
-            flat[key + "_count"] = s["count"]
-            flat[key + "_sum"] = round(s["sum"], 6)
+            cnt, tot = s["count"], round(s["sum"], 6)
+            snap[key + "_count"] = cnt
+            snap[key + "_sum"] = tot
+            if cnt != before.get(key + "_count", 0):
+                delta[key + "_count"] = cnt - before.get(key + "_count",
+                                                         0)
+                delta[key + "_sum"] = round(
+                    tot - before.get(key + "_sum", 0), 6)
+        elif s["type"] == "counter":
+            snap[key] = s["value"]
+            if s["value"] != before.get(key, 0):
+                delta[key] = s["value"] - before.get(key, 0)
         else:
-            flat[key] = s["value"]
-    return flat
+            snap[key] = s["value"]
+            if s["value"] != before.get(key):
+                delta[key] = s["value"]
+    return snap, delta
+
+
+def snapshot_delta(before):
+    """The registry's movement since `before` (a `snapshot()` result):
+    counters and histogram _count/_sum report the INCREMENT over the
+    window, gauges their current value; keys that didn't move are
+    dropped.  This is the honest per-window attribution — a cumulative
+    snapshot stamped onto one bench leg or flight-recorder step would
+    claim every previous window's counters as its own."""
+    return snapshot_and_delta(before)[1]

@@ -214,6 +214,26 @@ def squared_l2_norm(ctx, ins, attrs):
     return {"Out": [jnp.sum(jnp.square(_vals(_x(ins))))]}
 
 
+@register_op("isfinite", stop_gradient_op=True, nondiff_inputs=("X",))
+def isfinite(ctx, ins, attrs):
+    # reference: the CheckTensorNANOrInf scan (executor.cc:66-77) as an
+    # op: one bool — does X hold only finite values?  Jit-safe, so the
+    # numerics health monitor can run it inside a compiled segment.
+    x = _vals(_x(ins))
+    return {"Out": [jnp.reshape(jnp.all(jnp.isfinite(x)), (1,))]}
+
+
+@register_op("count_nonfinite", stop_gradient_op=True,
+             nondiff_inputs=("X",))
+def count_nonfinite(ctx, ins, attrs):
+    # int32 count of NaN/Inf elements in X — the on-device reduction
+    # behind `numerics_nonfinite_total` (obs/health.py); XLA fuses it
+    # into the surrounding segment, no extra HBM pass
+    x = _vals(_x(ins))
+    bad = jnp.logical_not(jnp.isfinite(x))
+    return {"Out": [jnp.reshape(jnp.sum(bad, dtype=jnp.int32), (1,))]}
+
+
 @register_op("l1_norm")
 def l1_norm(ctx, ins, attrs):
     return {"Out": [jnp.sum(jnp.abs(_vals(_x(ins))))]}

@@ -14,6 +14,8 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..jit import FunctionalProgram, state_from_scope
+from ..obs import flight as obs_flight
+from ..obs import health as obs_health
 from ..obs import telemetry as obs_tele
 from .sharding import (param_spec, batch_spec, is_optimizer_state,
                        optimizer_state_names, zero1_spec)
@@ -120,11 +122,23 @@ class ParallelTrainer:
         exe = executor or Executor(CPUPlace())
         exe.run(self.startup_program, scope=scope)
 
+        # numerics health: when enabled, the monitor's on-device
+        # reductions (nonfinite counts over fetches + grads, global
+        # grad norm) join the jitted step as extra replicated fetches —
+        # XLA folds the cross-chip reduce into the step executable
+        fetch_all = list(self.fetch_names)
+        self._monitor = None
+        if obs_health.enabled():
+            self._monitor = obs_health.NumericsMonitor(
+                self.main_program,
+                tensors=list(self.fetch_names)).install()
+            fetch_all += self._monitor.fetch_names
+
         fp = FunctionalProgram(self.main_program, self.feed_names,
-                               self.fetch_names)
+                               fetch_all)
         state = state_from_scope(fp, scope)
         self._step_fn, self._shardings = make_parallel_step(
-            self.main_program, self.feed_names, self.fetch_names,
+            self.main_program, self.feed_names, fetch_all,
             self.mesh, state, dp_axis=self.dp_axis, mp_axis=self.mp_axis,
             fp=fp, zero_stage=self.zero_stage, feed_specs=self.feed_specs)
         # place state on the mesh
@@ -147,13 +161,33 @@ class ParallelTrainer:
         # replicated loss/metric scalars every caller reads right
         # after, and new_state materializes in the same executable, so
         # this costs the host-side feed-prep overlap only.
-        with obs_tele.step("parallel", examples=examples, step=step_id):
-            # trace under the mesh context so mesh-aware op kernels
-            # (ring flash_attention) see the sp topology
-            with self.mesh:
-                fetches, self.state = self._step_fn(self.state, feeds,
-                                                    rng)
-            jax.block_until_ready(fetches)
+        try:
+            with obs_tele.step("parallel", examples=examples,
+                               step=step_id):
+                # trace under the mesh context so mesh-aware op kernels
+                # (ring flash_attention) see the sp topology
+                with self.mesh:
+                    fetches, self.state = self._step_fn(self.state,
+                                                        feeds, rng)
+                jax.block_until_ready(fetches)
+        except Exception as exc:
+            obs_flight.on_crash(exc, origin="parallel/step",
+                                step=step_id,
+                                feeds=obs_flight.describe_feeds(feeds))
+            raise
+        monitor = getattr(self, "_monitor", None)
+        if monitor is not None:
+            n_user = len(self.fetch_names)
+            monitor.record(dict(zip(monitor.fetch_names,
+                                    fetches[n_user:])))
+            fetches = fetches[:n_user]
+        if obs_flight.active():
+            loss = None
+            first = fetches[0] if fetches else None
+            if first is not None and getattr(first, "size", 0) == 1:
+                loss = float(np.asarray(first).reshape(-1)[0])
+            obs_flight.record_step("parallel", step_id, feeds=feeds,
+                                   loss=loss)
         return fetches
 
     def fetch_state(self, name):

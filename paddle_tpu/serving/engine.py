@@ -44,10 +44,17 @@ class EngineConfig:
     token_bucket: flat token-length multiple for ragged (LoD) feeds.
     warmup_ragged: also pre-compile the ragged feed path per bucket
         (one-token sequences); dense feeds always warm.
+    check_numerics: scan fetch outputs for NaN/Inf on the host after
+        each run, feeding `numerics_nonfinite_total{tensor=}` (the
+        /healthz nonfinite signal).  Off by default: it costs one
+        host pass over the outputs, which matters at large fetch
+        sizes (the JSON path re-reads them anyway, so turning it on
+        for HTTP serving is cheap in practice).
     """
 
     def __init__(self, batch_buckets=DEFAULT_BATCH_BUCKETS,
-                 token_bucket=DEFAULT_RAGGED_BUCKET, warmup_ragged=True):
+                 token_bucket=DEFAULT_RAGGED_BUCKET, warmup_ragged=True,
+                 check_numerics=False):
         if batch_buckets is not None:
             batch_buckets = tuple(sorted(set(int(b) for b in
                                              batch_buckets)))
@@ -56,6 +63,7 @@ class EngineConfig:
         self.batch_buckets = batch_buckets
         self.token_bucket = int(token_bucket)
         self.warmup_ragged = bool(warmup_ragged)
+        self.check_numerics = bool(check_numerics)
 
     def bucket_for(self, batch):
         """Smallest configured bucket >= batch (multiples of the
@@ -279,6 +287,7 @@ class InferenceEngine:
         {"pad": s, "compute": s}."""
         import jax
 
+        from ..obs import flight as obs_flight
         from ..obs import trace as obs_trace
 
         with self._lock, obs_trace.span("serving/engine_run",
@@ -289,11 +298,17 @@ class InferenceEngine:
             traces_before = self.trace_count()
             scope = (self.scope if self.scope is not None
                      else global_scope())
-            outs = self._exe.run(self.program, feed=padded,
-                                 fetch_list=self.fetch_names,
-                                 scope=scope, return_numpy=False)
-            jax.block_until_ready(
-                [getattr(o, "values", o) for o in outs if o is not None])
+            try:
+                outs = self._exe.run(self.program, feed=padded,
+                                     fetch_list=self.fetch_names,
+                                     scope=scope, return_numpy=False)
+                jax.block_until_ready(
+                    [getattr(o, "values", o) for o in outs
+                     if o is not None])
+            except Exception as exc:
+                obs_flight.on_crash(exc, origin="serving/engine",
+                                    batch=true_batch, bucket=bucket)
+                raise
             t2 = time.perf_counter()
             compiled = self.trace_count() > traces_before
             run_span.set(batch=true_batch, bucket=bucket,
@@ -307,7 +322,12 @@ class InferenceEngine:
             timings["pad"] = t1 - t0
             timings["compute"] = t2 - t1
             timings["compiled"] = compiled
-        return [self._slice_fetch(o, true_batch, bucket) for o in outs]
+        sliced = [self._slice_fetch(o, true_batch, bucket) for o in outs]
+        if self.config.check_numerics:
+            from ..obs import health as obs_health
+
+            obs_health.scan_outputs(zip(self.fetch_names, sliced))
+        return sliced
 
     # -- warmup -------------------------------------------------------------
     def _synthetic_feed(self, meta, batch):
@@ -333,15 +353,25 @@ class InferenceEngine:
         if has_ragged and not self.config.warmup_ragged:
             return 0
         # warmup compiles are startup cost, not traffic: keep them out
-        # of the request-path latency histograms and hit/miss counters
+        # of the request-path latency histograms and hit/miss counters.
+        # Memory/cost attribution is ON for these builds — the capture
+        # re-runs each segment's XLA compile (see Executor.
+        # _capture_xla_cost), roughly doubling warmup time, a deploy-
+        # time price paid once so /metrics carries the per-bucket
+        # xla_* footprints before traffic arrives.  force_attribution
+        # is a counting override, so concurrent warmups in one process
+        # can't race a flag save/restore.
+        from ..obs import health as obs_health
+
         saved_metrics, self.metrics = self.metrics, None
         warmed = 0
         try:
-            for bucket in self.config.batch_buckets:
-                feeds = {n: self._synthetic_feed(m, bucket)
-                         for n, m in self._feed_meta.items()}
-                self.run(feeds)
-                warmed += 1
+            with obs_health.force_attribution():
+                for bucket in self.config.batch_buckets:
+                    feeds = {n: self._synthetic_feed(m, bucket)
+                             for n, m in self._feed_meta.items()}
+                    self.run(feeds)
+                    warmed += 1
         finally:
             self.metrics = saved_metrics
         return warmed

@@ -4,7 +4,9 @@ Endpoints:
   POST /v1/infer   {"inputs": {name: nested lists}, "timeout_ms": n}
                    -> {"outputs": {fetch: nested lists}, "batch": B}
   GET  /metrics    prometheus-style text exposition
-  GET  /healthz    {"status": "ok" | "draining"}
+  GET  /healthz    {"status": "ok" | "draining", plus registry-derived
+                   signals: queue depth, error/shed totals, nonfinite
+                   counts, compile-cache misses — see docs/SERVING.md}
 
 Rejection contract (the backpressure surface): a full admission queue
 answers 429 immediately, an expired deadline 504, a draining server
@@ -83,8 +85,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, owner.metrics.render_text(),
                         content_type="text/plain; version=0.0.4")
         elif self.path == "/healthz":
-            self._reply(200, {"status": "draining" if owner.draining
-                              else "ok"})
+            self._reply(200, owner.health_signals())
         else:
             self._reply(404, {"error": "not found"})
 
@@ -155,6 +156,39 @@ class InferenceServer:
             self._http_thread.join(timeout=timeout)
             self._httpd.server_close()
 
+    def health_signals(self):
+        """The /healthz body: registry-derived liveness signals instead
+        of a bare status string (docs/SERVING.md).  `status` stays the
+        first-class field ("ok" | "draining"); the rest lets a probe
+        distinguish "up but shedding", "up but NaN-ing" and "up and
+        healthy" without scraping/parsing /metrics."""
+        from ..obs import registry as obs_registry
+        from ..obs import telemetry as obs_tele
+
+        # direct metric reads, NOT a full registry snapshot: liveness
+        # probes hit this every few seconds and must not serialize
+        # every family/histogram under their locks per probe
+        nonfinite_fam = obs_registry.get_registry().counter(
+            "numerics_nonfinite_total",
+            "NaN/Inf elements observed in watched tensors",
+            labelnames=("tensor",))
+        m = self.metrics
+        return {
+            "status": "draining" if self.draining else "ok",
+            "queue_depth": m.queue_depth.value,
+            "inflight_batches": m.inflight.value,
+            "requests_total": m.requests_total.value,
+            "responses_total": m.responses_total.value,
+            "errors_total": m.errors_total.value,
+            "shed_total": (m.rejected_queue_full.value
+                           + m.rejected_deadline.value
+                           + m.rejected_draining.value),
+            "compile_cache_miss_total": m.cache_miss_total.value,
+            "numerics_nonfinite_total": sum(
+                s["value"] for s in nonfinite_fam.samples()),
+            "jit_traces_total": obs_tele.jit_trace_count(),
+        }
+
     # -- request handling ---------------------------------------------------
     def _parse_inputs(self, payload):
         inputs = payload.get("inputs")
@@ -209,6 +243,9 @@ class InferenceServer:
         except (ValueError, KeyError, TypeError) as exc:
             return 400, {"error": str(exc)}
         except Exception as exc:  # noqa: BLE001 — server must answer
+            from ..obs import flight as obs_flight
+
+            obs_flight.on_crash(exc, origin="serving/http")
             return 500, {"error": "%s: %s" % (type(exc).__name__, exc)}
         outputs = {name: _jsonable(val) for name, val in
                    zip(self.engine.fetch_names, outs)}

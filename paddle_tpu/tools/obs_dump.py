@@ -4,6 +4,9 @@
     # validate a trace file someone handed you:
     python -m paddle_tpu.tools.obs_dump --check trace.json
 
+    # pretty-print a crash flight bundle (obs.flight):
+    python -m paddle_tpu.tools.obs_dump --flight flight_1234_001.json
+
     # the CI entry point (scripts/ci.sh, scripts/smoke.sh):
     python -m paddle_tpu.tools.obs_dump --selftest
 
@@ -16,11 +19,14 @@
                    "--metrics-out", "metrics.prom"])
 
 `--selftest` runs a tiny REAL workload under tracing — a v2 SGD
-trainer (executor underneath) plus a serving InferenceEngine request
-pair (compile miss + cache hit) — then asserts the exported trace is
-valid Chrome trace-event JSON with nested executor/trainer spans and
+trainer (executor underneath), a serving InferenceEngine request pair
+(compile miss + cache hit), and a deliberately-NaN health/flight leg
+(NumericsMonitor counts, locate_nonfinite names the op, an induced
+crash writes a flight bundle) — then asserts the exported trace is
+valid Chrome trace-event JSON with nested executor/trainer spans,
 that ONE registry render carries executor, trainer and serving
-metrics.  See docs/OBSERVABILITY.md for naming conventions.
+metrics, and that the per-segment xla_* memory/cost gauges landed.
+See docs/OBSERVABILITY.md for naming conventions.
 """
 
 import argparse
@@ -44,6 +50,9 @@ def parse_args(argv=None):
     p.add_argument("--check", default=None, metavar="TRACE_JSON",
                    help="validate an existing Chrome trace file and "
                         "exit")
+    p.add_argument("--flight", default=None, metavar="BUNDLE_JSON",
+                   help="validate and pretty-print a flight-recorder "
+                        "bundle (obs.flight) and exit")
     p.add_argument("--selftest", action="store_true",
                    help="run a tiny traced workload and assert the "
                         "whole obs pipeline works end to end")
@@ -89,6 +98,71 @@ def validate_prometheus_text(text):
         names.add(name)
     assert names, "no metric samples in exposition"
     return names
+
+
+def validate_flight_bundle(doc):
+    """Assert `doc` (dict or path) is a well-formed flight-recorder
+    bundle; returns the loaded dict."""
+    if not isinstance(doc, dict):
+        with open(doc) as f:
+            doc = json.load(f)
+    assert doc.get("kind") == "paddle_tpu.flight", \
+        "not a flight bundle (kind=%r)" % doc.get("kind")
+    assert isinstance(doc.get("version"), int)
+    assert isinstance(doc.get("steps"), list)
+    assert isinstance(doc.get("registry"), dict)
+    assert isinstance(doc.get("notes"), list)
+    for rec in doc["steps"]:
+        assert "step" in rec and "trainer" in rec, rec
+        assert isinstance(rec.get("telemetry_delta", {}), dict)
+    exc = doc.get("exception")
+    if exc is not None:
+        assert "type" in exc and "message" in exc, exc
+    return doc
+
+
+def render_flight(doc, max_steps=8):
+    """Human-readable summary of a flight bundle (the --flight CLI
+    output)."""
+    doc = validate_flight_bundle(doc)
+    lines = []
+    lines.append("flight bundle v%d  reason=%s  steps=%d (%d dropped)"
+                 % (doc["version"], doc.get("reason"),
+                    len(doc["steps"]), doc.get("dropped_steps", 0)))
+    exc = doc.get("exception")
+    if exc:
+        lines.append("exception: %s: %s" % (exc["type"], exc["message"]))
+        tb = exc.get("traceback") or ""
+        lines.extend("  " + l for l in tb.rstrip().splitlines()[-3:])
+    for note in doc.get("notes", []):
+        ctx = {k: v for k, v in note.items() if k not in ("t", "origin")}
+        lines.append("note [%s] %s" % (note.get("origin"), ctx))
+    steps = doc["steps"][-max_steps:]
+    if steps:
+        lines.append("last %d step(s):" % len(steps))
+    for rec in steps:
+        delta = rec.get("telemetry_delta") or {}
+        bits = ["step=%s" % rec.get("step"),
+                "trainer=%s" % rec.get("trainer")]
+        if rec.get("loss") is not None:
+            bits.append("loss=%.6g" % rec["loss"])
+        if rec.get("feeds"):
+            bits.append("feeds=%s" % rec["feeds"])
+        bits.append("%d metric(s) moved" % len(delta))
+        lines.append("  " + "  ".join(bits))
+    reg = doc.get("registry", {})
+    interesting = {k: v for k, v in sorted(reg.items())
+                   if k.startswith(("numerics_", "grad_global_norm",
+                                    "amp_loss_scale", "xla_",
+                                    "trainer_last_loss",
+                                    "executor_jit_traces_total"))}
+    lines.append("registry: %d metric sample(s)%s"
+                 % (len(reg), "" if not interesting
+                    else ", notable:"))
+    for k, v in interesting.items():
+        lines.append("  %s = %g" % (k, v))
+    lines.append("recent spans: %d" % len(doc.get("recent_spans", [])))
+    return "\n".join(lines)
 
 
 def _find_span(events, prefix):
@@ -168,6 +242,61 @@ def _serve_tiny():
     return metrics
 
 
+def _health_flight_tiny(workdir):
+    """The diagnosis loop end to end: a deliberately-NaN step makes the
+    NumericsMonitor count, locate_nonfinite names the offending op, and
+    an induced crash leaves a flight bundle this CLI can render."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.obs import flight as obs_flight
+    from paddle_tpu.obs import health as obs_health
+    from paddle_tpu.utils import flags
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=3)
+        cost = fluid.layers.mean(x=h)
+        _, pg = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1).minimize(cost)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        monitor = obs_health.NumericsMonitor.for_train_program(
+            main_prog, cost=cost, params_grads=pg).install()
+        bad = np.full((2, 4), np.nan, np.float32)
+        outs = exe.run(main_prog, feed={"x": bad},
+                       fetch_list=[cost] + monitor.fetch_names)
+        summary = monitor.record(dict(zip(monitor.fetch_names,
+                                          outs[1:])))
+        assert summary["found_nonfinite"], summary
+        report = obs_health.locate_nonfinite(main_prog, {"x": bad},
+                                             scope=scope)
+        assert report and report["op_type"], report
+
+        # induced crash through the executor's exception hook
+        recorder = obs_flight.install(out_dir=workdir, capacity=8)
+        flag_prev = flags.get_flag("check_nan_inf")
+        flags.set_flag("check_nan_inf", True)
+        try:
+            exe.run(main_prog, feed={"x": bad}, fetch_list=[cost],
+                    eager=True, use_program_cache=False)
+            raise AssertionError("NaN feed did not trip check_nan_inf")
+        except fluid.executor.NonfiniteError:
+            pass
+        finally:
+            flags.set_flag("check_nan_inf", flag_prev)
+            obs_flight.uninstall()
+    bundle = recorder.last_bundle_path
+    assert bundle and os.path.exists(bundle), "no flight bundle written"
+    rendered = render_flight(bundle)
+    assert "NonfiniteError" in rendered
+    return report, bundle
+
+
 def selftest(args):
     # the selftest must never contend for a real accelerator
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -176,16 +305,24 @@ def selftest(args):
     from paddle_tpu.obs import telemetry as obs_tele
     from paddle_tpu.obs import trace as obs_trace
 
+    from paddle_tpu.utils import flags as pt_flags
+
+    workdir = tempfile.mkdtemp(prefix="paddle_obs_")
     obs_trace.enable(clear=True)
+    # exercise the memory/cost attribution path (off by default; the
+    # serving warmup and bench suite enable it in production)
+    attr_prev = pt_flags.get_flag("xla_cost_attribution")
+    pt_flags.set_flag("xla_cost_attribution", True)
     try:
         _train_tiny_v2()
         metrics = _serve_tiny()
+        health_report, flight_bundle = _health_flight_tiny(workdir)
     finally:
+        pt_flags.set_flag("xla_cost_attribution", attr_prev)
         obs_trace.disable()
 
     # --- trace side: valid Chrome JSON, nested executor+trainer spans
-    trace_path = args.trace_out or os.path.join(
-        tempfile.mkdtemp(prefix="paddle_obs_"), "trace.json")
+    trace_path = args.trace_out or os.path.join(workdir, "trace.json")
     obs_trace.export_chrome_trace(trace_path)
     events = validate_chrome_trace(trace_path)
     steps = _find_span(events, "v2/step")
@@ -215,6 +352,19 @@ def selftest(args):
     assert obs_tele.jit_trace_count() > 0
     assert obs_tele.transfer_bytes("h2d") > 0
 
+    # --- health side: the NaN loop counted, and the compile-time
+    # memory/cost attribution landed as per-segment xla_* gauges
+    # (graceful skip where the runtime exposes no analyses)
+    snap = obs_tele.snapshot()
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in snap.items()), \
+        "NaN run left no numerics_nonfinite_total samples"
+    xla_gauges = sorted({k.split("{", 1)[0] for k in snap
+                         if k.startswith("xla_")})
+    if not xla_gauges:
+        print("[obs] note: runtime exposes no XLA memory/cost "
+              "analyses; xla_* gauges skipped", flush=True)
+
     # the same data is exportable as JSONL for offline diffing
     jsonl = obs_registry.get_registry().render_jsonl()
     for line in jsonl.strip().splitlines():
@@ -224,9 +374,13 @@ def selftest(args):
         _write_metrics(args, text if args.format == "prom" else jsonl)
     print("[obs] selftest green: %d trace events (%d trainer steps, "
           "%d executor runs, %d jit segments, %d serving spans), "
-          "unified /metrics has %d metric families, trace at %s"
+          "unified /metrics has %d metric families, xla gauges %s, "
+          "first nonfinite op %r, flight bundle at %s, trace at %s"
           % (len(events), len(steps), len(runs), len(segs),
-             len(serving_spans), len(names), trace_path), flush=True)
+             len(serving_spans), len(names),
+             ",".join(xla_gauges) or "n/a",
+             health_report["op_type"], flight_bundle, trace_path),
+          flush=True)
     return 0
 
 
@@ -251,9 +405,12 @@ def main(argv=None):
         print("[obs] %s: valid Chrome trace with %d events"
               % (args.check, len(events)), flush=True)
         return 0
+    if args.flight:
+        print(render_flight(args.flight), flush=True)
+        return 0
     if not args.trace_out and not args.metrics_out:
         raise SystemExit("nothing to do: pass --selftest, --check, "
-                         "--trace-out and/or --metrics-out")
+                         "--flight, --trace-out and/or --metrics-out")
     from paddle_tpu.obs import registry as obs_registry
     from paddle_tpu.obs import trace as obs_trace
 

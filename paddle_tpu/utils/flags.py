@@ -89,6 +89,15 @@ DEFINE_flag("bn_shifted_stats", False,
             "TPU A/B, ResNet-50 b128: plain 2471.1 vs shifted 2129.5 "
             "img/s); the plain E[x^2]-E[x]^2 form accumulates in f32 "
             "with a >=0 clamp, fine for normalized inputs")
+DEFINE_flag("xla_cost_attribution", False,
+            "capture per-segment XLA memory/cost analyses at jit-build "
+            "time into xla_* registry gauges (obs/health.py).  The AOT "
+            "capture path re-runs the XLA compile (jax's call-path "
+            "executable cache is not shared), roughly doubling a "
+            "segment's first-build cost — hence default off; serving "
+            "warmup and mega_bench's non-risky legs enable it, the "
+            "surfaces whose /metrics and BENCH artifacts consume the "
+            "attribution and can afford the startup cost")
 DEFINE_flag("amp_bf16_act", True,
             "when amp_bf16 is on, keep activations bfloat16 between ops "
             "instead of casting every MXU output back to f32 — halves "

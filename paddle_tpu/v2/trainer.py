@@ -7,6 +7,8 @@ import numpy as np
 
 from .. import fluid
 from ..fluid import framework
+from ..obs import flight as obs_flight
+from ..obs import health as obs_health
 from ..obs import telemetry as obs_tele
 from . import event as v2_event
 from . import layer as v2_layer
@@ -72,6 +74,20 @@ class SGD:
                 feeding, self._main_program),
             place=_place())
 
+    def _numerics_monitor(self):
+        """Install (once) and return the numerics health monitor when
+        `obs.health.enable()` is active; None otherwise.  The monitor's
+        on-device reductions ride the regular fetch list — see
+        docs/OBSERVABILITY.md."""
+        if not obs_health.enabled():
+            return None
+        if getattr(self, "_health_monitor", None) is None:
+            self._health_monitor = obs_health.NumericsMonitor \
+                .for_train_program(self._main_program, cost=self._cost,
+                                   params_grads=self._params_grads) \
+                .install()
+        return self._health_monitor
+
     def train(self, reader, num_passes=1, event_handler=None,
               feeding=None, save_dir=None):
         """save_dir: when set, parameters are written to
@@ -82,7 +98,12 @@ class SGD:
             event_handler = lambda e: None
         feeder = self._feeder(feeding)
         fetch = [self._cost] + list(self._extra)
+        n_user = len(fetch)
+        monitor = self._numerics_monitor()
+        if monitor is not None:
+            fetch = fetch + monitor.fetch_names
 
+        step_index = 0
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             pass_costs = []
@@ -90,14 +111,34 @@ class SGD:
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 # step telemetry: wall time + examples/sec into the
                 # unified registry, a v2/step span on the trace
-                with obs_tele.step("v2", examples=len(data),
-                                   pass_id=pass_id, batch_id=batch_id):
-                    outs = self._exe.run(self._main_program,
-                                         feed=feeder.feed(data),
-                                         fetch_list=fetch)
+                feed = None
+                try:
+                    feed = feeder.feed(data)
+                    with obs_tele.step("v2", examples=len(data),
+                                       pass_id=pass_id,
+                                       batch_id=batch_id):
+                        outs = self._exe.run(self._main_program,
+                                             feed=feed,
+                                             fetch_list=fetch)
+                except Exception as exc:
+                    obs_flight.on_crash(
+                        exc, origin="v2/train", pass_id=pass_id,
+                        batch_id=batch_id,
+                        feeds=obs_flight.describe_feeds(feed)
+                        if feed else None)
+                    raise
+                if monitor is not None:
+                    monitor.record(dict(zip(monitor.fetch_names,
+                                            outs[n_user:])))
+                    outs = outs[:n_user]
                 cost = float(np.asarray(outs[0]).reshape(-1)[0])
                 obs_tele.set_gauge("trainer_last_loss", cost,
                                    trainer="v2")
+                if obs_flight.active():
+                    obs_flight.record_step("v2", step_index, feeds=feed,
+                                           loss=cost, pass_id=pass_id,
+                                           batch_id=batch_id)
+                step_index += 1
                 pass_costs.append(cost)
                 event_handler(v2_event.EndForwardBackward(
                     pass_id, batch_id))

@@ -31,8 +31,15 @@ TPU window.  MEGA_SUBPROC=all extends the guard to every leg.
 
 Every leg's wall/compile timings flow through the paddle_tpu.obs
 registry (mega_leg_wall_seconds / mega_leg_jit_traces, labeled by
-leg) and are stamped into the leg's BENCH_LAST_TPU.json records as a
-"metrics" blob, so a round's artifact carries its own timing context.
+leg) and the leg's registry DELTA (telemetry.snapshot_delta: counter
+increments + current gauges — leg timings, executor trace/transfer
+movement, per-segment xla_* memory and FLOP gauges) is stamped into
+the leg's BENCH_LAST_TPU.json records as the "metrics" blob, so a
+round's artifact carries its own measurement context without claiming
+earlier legs' counters.  In-process non-RISKY legs run with
+FLAGS_xla_cost_attribution on (the capture re-runs each segment's
+compile — it inflates leg wall time, never the measured img/s, and is
+kept away from the known-pathological googlenet compiles).
 """
 
 import gc
@@ -112,8 +119,10 @@ def _fresh_records(since):
 
 def _attach_metrics(keys, blob):
     """Stamp each freshly-persisted BENCH record with the leg's
-    observability blob (wall/compile timings from paddle_tpu.obs), so
-    the round's artifact carries its own measurement context."""
+    observability blob — the leg's telemetry.snapshot_delta() over the
+    unified registry (leg wall/compile gauges, executor counter
+    increments, xla_* memory and FLOP attribution), so the round's
+    artifact carries its own measurement context."""
     if not blob:
         return
     try:
@@ -175,11 +184,14 @@ def run_one_guarded(name, overrides, timeout):
     measurement is lost.  The child persists its own records to
     BENCH_LAST_TPU.json, so the parent's freshness check still sees
     them."""
+    from paddle_tpu.obs import telemetry as obs_tele
+
     env = dict(os.environ)
     for k in _MANAGED:
         env.pop(k, None)
     env.update(overrides)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    snap_before = obs_tele.snapshot()
     t0 = time.perf_counter()
     proc = subprocess.Popen([sys.executable, "bench.py"], cwd=repo,
                             env=env)
@@ -187,10 +199,11 @@ def run_one_guarded(name, overrides, timeout):
         rc = proc.wait(timeout=timeout)
         wall = time.perf_counter() - t0
         # child-process legs report wall only (the child's obs
-        # registry dies with it; its record still gets the blob)
+        # registry dies with it); the delta keeps the blob from
+        # claiming earlier in-process legs' counters
         _leg_registry_emit(name, wall)
         if rc == 0:
-            return "ok", {"wall_s": round(wall, 3)}
+            return "ok", obs_tele.snapshot_delta(snap_before)
         return "failed", None
     except subprocess.TimeoutExpired:
         # same caveat as the claim probe: a child wedged in compile can
@@ -208,8 +221,10 @@ def run_one_guarded(name, overrides, timeout):
 
 def run_one(name, overrides):
     """Run one leg in-process.  Returns the leg's metrics blob on
-    success (wall time + executor jit trace/compile count, both also
-    emitted through the obs registry), None on failure."""
+    success — telemetry.snapshot_delta() over the leg (wall/compile
+    timings land there via _leg_registry_emit, next to executor
+    counter increments and the per-segment xla_* gauges captured
+    during the leg's jit builds) — None on failure."""
     from paddle_tpu.fluid import amp
     from paddle_tpu.obs import telemetry as obs_tele
     from paddle_tpu.utils import flags
@@ -223,6 +238,12 @@ def run_one(name, overrides):
         if "FLAGS_" + k not in overrides:
             flags.set_flag(k, flags._FLAGS[k]["default"])
     amp.disable_bf16()           # bench.main re-enables unless AMP=0
+    # memory/FLOP attribution doubles a segment's first-build compile
+    # (see Executor._capture_xla_cost): fine for normal legs (inflates
+    # leg wall, never the timed-iteration img/s), but never double the
+    # known-pathological googlenet compiles
+    flags.set_flag("xla_cost_attribution", name not in RISKY)
+    snap_before = obs_tele.snapshot()
     traces_before = obs_tele.jit_trace_count()
     t0 = time.perf_counter()
     try:
@@ -230,7 +251,7 @@ def run_one(name, overrides):
         wall = time.perf_counter() - t0
         jit_traces = obs_tele.jit_trace_count() - traces_before
         _leg_registry_emit(name, wall, jit_traces)
-        return {"wall_s": round(wall, 3), "jit_traces": jit_traces}
+        return obs_tele.snapshot_delta(snap_before)
     except BaseException as e:   # noqa: BLE001 — keep measuring
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             raise
@@ -242,6 +263,8 @@ def run_one(name, overrides):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        flags.set_flag("xla_cost_attribution",
+                       flags._FLAGS["xla_cost_attribution"]["default"])
         flags.parse_flags_from_env()
         gc.collect()
 

@@ -23,6 +23,27 @@ jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(autouse=True)
+def fresh_obs():
+    """Observability state is process-global (default registry, span
+    tracer, flight recorder, health switch): reset it around every
+    test so counters don't bleed across tests and order-dependent
+    assertions can't flake."""
+    from paddle_tpu.obs import flight as obs_flight
+    from paddle_tpu.obs import health as obs_health
+    from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.obs import trace as obs_trace
+
+    obs_registry.reset_registry()
+    obs_trace.disable()
+    obs_trace.reset()
+    yield
+    obs_health.disable()
+    obs_flight.uninstall()
+    obs_trace.disable()
+    obs_trace.reset()
+
+
+@pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs/scope (the reference's tests
     run one per process; ours share a process)."""

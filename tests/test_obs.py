@@ -212,6 +212,25 @@ def test_telemetry_step_and_gauges():
     assert flat["trainer_steps_total{trainer=t_obs}"] >= 1
 
 
+def test_telemetry_snapshot_delta_counters_vs_gauges():
+    reg = obs_registry.get_registry()
+    reg.counter("delta_total").inc(2)
+    reg.gauge("delta_gauge").set(5)
+    reg.histogram("delta_seconds").observe(0.2)
+    before = obs_tele.snapshot()
+    assert obs_tele.snapshot_delta(before) == {}   # nothing moved
+    reg.counter("delta_total").inc(3)
+    reg.gauge("delta_gauge").set(7)
+    reg.histogram("delta_seconds").observe(0.3)
+    reg.counter("delta_untouched_total").inc(0)    # new but at 0
+    d = obs_tele.snapshot_delta(before)
+    assert d["delta_total"] == 3                   # increment, not 5
+    assert d["delta_gauge"] == 7                   # current value
+    assert d["delta_seconds_count"] == 1
+    assert abs(d["delta_seconds_sum"] - 0.3) < 1e-6
+    assert "delta_untouched_total" not in d
+
+
 def _tiny_program():
     x = fluid.layers.data(name="x", shape=[4], dtype="float32")
     h = fluid.layers.fc(input=x, size=3, act="relu")
@@ -343,6 +362,9 @@ def test_serving_metrics_render_is_unified():
 def test_obs_dump_cli_dump_modes(tmp_path):
     from paddle_tpu.tools import obs_dump
 
+    # the registry is reset between tests (conftest fresh_obs); the
+    # dump needs at least one sample of its own
+    obs_registry.get_registry().counter("cli_dump_total").inc()
     with obs_trace.tracing():
         with obs_trace.span("cli_span"):
             pass

@@ -1,0 +1,568 @@
+"""paddle_tpu.obs.health + obs.flight: jit-safe numerics monitoring,
+the eager NaN bisection, XLA memory/cost attribution gauges, the crash
+flight recorder, and the enriched serving /healthz.
+
+Tier-1 (CPU).  The acceptance loop lives in
+test_nan_training_full_loop: a deliberately-NaN training run makes
+`numerics_nonfinite_total` count, `locate_nonfinite` names the first
+offending op, and the induced crash leaves a flight bundle that
+`obs_dump --flight` renders."""
+
+import http.client
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.fluid.amp import LossScaler
+from paddle_tpu.fluid.executor import NonfiniteError
+from paddle_tpu.obs import flight as obs_flight
+from paddle_tpu.obs import health as obs_health
+from paddle_tpu.obs import registry as obs_registry
+from paddle_tpu.obs import telemetry as obs_tele
+from paddle_tpu.tools import obs_dump
+from paddle_tpu.utils import flags
+
+
+def _train_program():
+    """x -> fc -> mean cost with SGD update ops; returns
+    (cost, params_grads)."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3)
+    cost = fluid.layers.mean(x=h)
+    _, pg = fluid.optimizer.SGDOptimizer(learning_rate=0.1) \
+        .minimize(cost)
+    return cost, pg
+
+
+def _run_startup():
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe
+
+
+NAN_BATCH = np.full((2, 4), np.nan, np.float32)
+ONES_BATCH = np.ones((2, 4), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def test_isfinite_and_count_nonfinite_ops():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.registry import get_op_info
+
+    x = jnp.asarray([1.0, np.nan, np.inf, -2.0], jnp.float32)
+    fin = get_op_info("isfinite").kernel(None, {"X": [x]}, {})["Out"][0]
+    assert not bool(np.asarray(fin)[0])
+    cnt = get_op_info("count_nonfinite").kernel(
+        None, {"X": [x]}, {})["Out"][0]
+    assert np.asarray(cnt)[0] == 2
+    ok = get_op_info("isfinite").kernel(
+        None, {"X": [jnp.zeros((3,))]}, {})["Out"][0]
+    assert bool(np.asarray(ok)[0])
+
+
+# ---------------------------------------------------------------------------
+# check_nan_inf: direct coverage of the eager flag path (satellite)
+# ---------------------------------------------------------------------------
+
+def test_check_nan_inf_eager_raises_with_op_identity():
+    cost, _ = _train_program()
+    exe = _run_startup()
+    prev = flags.get_flag("check_nan_inf")
+    flags.set_flag("check_nan_inf", True)
+    try:
+        with pytest.raises(NonfiniteError) as ei:
+            exe.run(fluid.default_main_program(),
+                    feed={"x": NAN_BATCH}, fetch_list=[cost],
+                    eager=True)
+    finally:
+        flags.set_flag("check_nan_inf", prev)
+    err = ei.value
+    assert err.op_type == "mul"        # fc's matmul is the first op
+    assert err.op_index == 0
+    assert err.var_name and err.nonfinite_count > 0
+
+
+def test_check_nan_inf_does_not_guard_jitted_path():
+    """The documented gap: the flag only scans the eager interpreter —
+    a jitted run of the same NaN feed completes silently (which is why
+    health.locate_nonfinite exists)."""
+    cost, _ = _train_program()
+    exe = _run_startup()
+    prev = flags.get_flag("check_nan_inf")
+    flags.set_flag("check_nan_inf", True)
+    try:
+        outs = exe.run(fluid.default_main_program(),
+                       feed={"x": NAN_BATCH}, fetch_list=[cost])
+    finally:
+        flags.set_flag("check_nan_inf", prev)
+    assert np.isnan(np.asarray(outs[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# NumericsMonitor
+# ---------------------------------------------------------------------------
+
+def test_numerics_monitor_counts_maxabs_and_grad_norm():
+    cost, pg = _train_program()
+    exe = _run_startup()
+    main = fluid.default_main_program()
+    mon = obs_health.NumericsMonitor.for_train_program(
+        main, cost=cost, params_grads=pg).install()
+    assert mon.fetch_names
+    assert mon.install() is mon  # idempotent
+
+    outs = exe.run(main, feed={"x": ONES_BATCH},
+                   fetch_list=[cost] + mon.fetch_names)
+    s = mon.record(dict(zip(mon.fetch_names, outs[1:])))
+    assert not s["found_nonfinite"]
+    assert all(c == 0 for c in s["nonfinite"].values())
+    assert s["grad_global_norm"] > 0
+    assert np.isfinite(s["grad_global_norm"])
+
+    outs = exe.run(main, feed={"x": NAN_BATCH},
+                   fetch_list=[cost] + mon.fetch_names)
+    s = mon.record(outs[1:])   # sequence form
+    assert s["found_nonfinite"]
+    assert sum(s["nonfinite"].values()) > 0
+
+    # registry side: the counter family carries per-tensor children,
+    # the gauges landed
+    flat = obs_tele.snapshot()
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in flat.items())
+    assert any(k.startswith("numerics_max_abs{") for k in flat)
+    assert "grad_global_norm" in flat
+
+
+def test_numerics_monitor_grad_discovery_matches_params_grads():
+    cost, pg = _train_program()
+    main = fluid.default_main_program()
+    discovered = obs_health.NumericsMonitor(main)._discover_grads()
+    assert set(discovered) == {g.name for _, g in pg if g is not None}
+
+
+def test_numerics_monitor_v2_trainer_wiring():
+    """health.enable() makes the v2 SGD loop install a monitor and
+    feed the registry without any trainer-code changes by the user."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init()
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y",
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            momentum=0.9, learning_rate=0.1))
+
+    def reader():
+        yield [(np.ones(4, np.float32), np.ones(1, np.float32))]
+        yield [(np.full(4, np.nan, np.float32),
+                np.ones(1, np.float32))]
+
+    obs_health.enable()
+    try:
+        trainer.train(reader=reader, num_passes=1,
+                      feeding={"x": 0, "y": 1})
+    finally:
+        obs_health.disable()
+    flat = obs_tele.snapshot()
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in flat.items()), flat
+    assert "grad_global_norm" in flat
+
+
+def test_numerics_monitor_parallel_trainer_wiring():
+    """The mesh-parallel trainer installs a monitor too: the reductions
+    run INSIDE the sharded jitted step and come back as replicated
+    scalars, stripped before the user sees the fetches."""
+    from paddle_tpu.parallel import ParallelTrainer, make_mesh
+
+    fluid.framework.reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8, 4], dtype="float32",
+                              append_batch_size=False)
+        h = fluid.layers.fc(input=x, size=4)
+        avg = fluid.layers.mean(x=h)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(avg)
+    obs_health.enable()
+    try:
+        tr = ParallelTrainer(main, startup, feed_names=["x"],
+                             fetch_names=[avg.name],
+                             mesh=make_mesh(n_devices=8)).init()
+    finally:
+        obs_health.disable()
+    fetches = tr.step({"x": np.full((8, 4), np.nan, np.float32)})
+    assert len(fetches) == 1          # monitor fetches were stripped
+    flat = obs_tele.snapshot()
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in flat.items()), flat
+    assert "grad_global_norm" in flat
+
+
+def test_loss_scaler_dynamics_and_gauge():
+    scaler = LossScaler(init_scale=1024.0, growth_interval=2,
+                        min_scale=1.0)
+    assert obs_tele.snapshot()["amp_loss_scale"] == 1024.0
+    assert scaler.update(True) == 512.0       # overflow: back off
+    assert scaler.update(False) == 512.0      # 1 clean step
+    assert scaler.update(False) == 1024.0     # growth_interval reached
+    assert obs_tele.snapshot()["amp_loss_scale"] == 1024.0
+    for _ in range(40):
+        scaler.update(True)
+    assert scaler.scale == 1.0                # floored at min_scale
+
+    # monitor drives the scaler from the on-device nonfinite counts
+    cost, pg = _train_program()
+    exe = _run_startup()
+    main = fluid.default_main_program()
+    mon = obs_health.NumericsMonitor.for_train_program(
+        main, cost=cost, params_grads=pg,
+        loss_scaler=LossScaler(init_scale=8.0, min_scale=1.0)).install()
+    outs = exe.run(main, feed={"x": NAN_BATCH},
+                   fetch_list=[cost] + mon.fetch_names)
+    s = mon.record(outs[1:])
+    assert s["loss_scale"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# locate_nonfinite
+# ---------------------------------------------------------------------------
+
+def test_locate_nonfinite_names_first_op_and_preserves_state():
+    cost, _ = _train_program()
+    exe = _run_startup()
+    main = fluid.default_main_program()
+    from paddle_tpu.core.scope import global_scope
+
+    w_before = np.array(global_scope().get("fc_0.w_0"))
+    report = obs_health.locate_nonfinite(main, {"x": NAN_BATCH})
+    assert report is not None
+    assert report["op_type"] == "mul"
+    assert report["op_index"] == 0
+    assert report["nonfinite_count"] > 0
+    assert "mul" in report["message"]
+    # the replay ran against a scope clone: optimizer state untouched
+    np.testing.assert_array_equal(
+        w_before, np.array(global_scope().get("fc_0.w_0")))
+    # a finite feed replays clean
+    assert obs_health.locate_nonfinite(main, {"x": ONES_BATCH}) is None
+    # the check_nan_inf flag was restored
+    assert flags.get_flag("check_nan_inf") is False
+
+
+# ---------------------------------------------------------------------------
+# XLA memory/cost attribution
+# ---------------------------------------------------------------------------
+
+def test_xla_cost_gauges_after_jit_build():
+    prev = flags.get_flag("xla_cost_attribution")
+    flags.set_flag("xla_cost_attribution", True)
+    try:
+        cost, _ = _train_program()
+        exe = _run_startup()
+        exe.run(fluid.default_main_program(), feed={"x": ONES_BATCH},
+                fetch_list=[cost])
+    finally:
+        flags.set_flag("xla_cost_attribution", prev)
+    flat = obs_tele.snapshot()
+    seg_labels = [k for k in flat
+                  if k.startswith("xla_argument_bytes{segment=")]
+    assert seg_labels, "no xla_* gauges after a jit build:\n%s" % flat
+    assert any(k.startswith("xla_flops{") for k in flat)
+    # the gauges ride the unified /metrics render
+    text = obs_registry.get_registry().render_text()
+    assert "xla_argument_bytes{" in text
+
+
+def test_xla_cost_attribution_off_by_default():
+    assert flags.get_flag("xla_cost_attribution") is False
+    cost, _ = _train_program()
+    exe = _run_startup()
+    exe.run(fluid.default_main_program(), feed={"x": ONES_BATCH},
+            fetch_list=[cost])
+    assert not any(k.startswith("xla_")
+                   for k in obs_tele.snapshot())
+
+
+def test_xla_cost_gauges_from_serving_warmup():
+    """The serving surface gets attribution without any flag fiddling:
+    warmup() turns it on for its bucket builds and restores it."""
+    engine = _serving_engine(check_numerics=False)
+    assert engine.warmup() == 2
+    assert flags.get_flag("xla_cost_attribution") is False  # restored
+    flat = obs_tele.snapshot()
+    assert any(k.startswith("xla_argument_bytes{") for k in flat), flat
+    assert any(k.startswith("xla_flops{") for k in flat)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_ring_bound_and_bundle_schema(tmp_path):
+    rec = obs_flight.FlightRecorder(out_dir=str(tmp_path), capacity=4)
+    for i in range(10):
+        rec.record_step("t", i, feeds={"x": ONES_BATCH}, loss=float(i))
+    rec.note("unit", detail="ctx")
+    path = rec.dump(reason="unit-test")
+    doc = obs_dump.validate_flight_bundle(path)
+    assert len(doc["steps"]) == 4                 # ring bound
+    assert doc["dropped_steps"] == 6
+    assert [r["step"] for r in doc["steps"]] == [6, 7, 8, 9]
+    assert doc["steps"][-1]["loss"] == 9.0
+    assert doc["steps"][0]["feeds"] == {"x": "float32[2, 4]"}
+    assert doc["notes"][-1]["origin"] == "unit"
+    assert isinstance(doc["registry"], dict)
+    rendered = obs_dump.render_flight(doc)
+    assert "unit-test" in rendered
+
+
+def test_flight_step_records_carry_telemetry_deltas(tmp_path):
+    rec = obs_flight.FlightRecorder(out_dir=str(tmp_path))
+    obs_registry.get_registry().counter("flight_probe_total").inc(3)
+    r1 = rec.record_step("t", 0)
+    assert r1["telemetry_delta"].get("flight_probe_total") == 3
+    r2 = rec.record_step("t", 1)         # nothing moved since
+    assert "flight_probe_total" not in r2["telemetry_delta"]
+    obs_registry.get_registry().counter("flight_probe_total").inc()
+    r3 = rec.record_step("t", 2)
+    # counter deltas are INCREMENTS (1 tick this step), not the new
+    # cumulative value (4) — a post-mortem reads per-step movement
+    assert r3["telemetry_delta"].get("flight_probe_total") == 1
+
+
+def test_flight_dump_storm_rotates_and_rate_limits(tmp_path):
+    # rotation: total files bounded, NEWEST crashes keep their bundles
+    # (a lifetime cap would spend the budget on early handled errors
+    # and leave the genuine crash at the end with no post-mortem)
+    rec = obs_flight.FlightRecorder(out_dir=str(tmp_path),
+                                    max_bundles=2,
+                                    min_dump_interval_s=0.0)
+    last = [rec.dump_once(RuntimeError("e%d" % i), reason="storm")
+            for i in range(10)][-1]
+    bundles = sorted(f for f in os.listdir(str(tmp_path))
+                     if f.startswith("flight_"))
+    assert len(bundles) == 2              # rotated, not 10 files
+    assert os.path.basename(last) in bundles   # newest survived
+    assert rec.suppressed_dumps == 0
+
+    # rate limit: within the interval, dump_once reuses the last path
+    rec2 = obs_flight.FlightRecorder(out_dir=str(tmp_path / "rl"),
+                                     min_dump_interval_s=3600.0)
+    p1 = rec2.dump_once(RuntimeError("a"), reason="x")
+    p2 = rec2.dump_once(RuntimeError("b"), reason="x")
+    assert p1 == p2 and rec2.suppressed_dumps == 1
+
+
+def test_flight_install_excepthook_and_dedup(tmp_path):
+    rec = obs_flight.install(out_dir=str(tmp_path),
+                             min_dump_interval_s=0.0)
+    assert obs_flight.active()
+    try:
+        exc = RuntimeError("boom")
+        p1 = obs_flight.on_crash(exc, origin="layer-a")
+        p2 = obs_flight.on_crash(exc, origin="layer-b")  # same object
+        assert p1 == p2                   # one bundle per exception
+        assert os.path.exists(p1)
+        # the chained excepthook writes for a fresh exception
+        exc2 = ValueError("uncaught")
+        sys.excepthook(ValueError, exc2, None)
+        assert rec.last_bundle_path != p1
+        doc = obs_dump.validate_flight_bundle(rec.last_bundle_path)
+        assert doc["exception"]["type"] == "ValueError"
+        with obs_flight.suppressed():
+            assert obs_flight.on_crash(RuntimeError("x")) is None
+    finally:
+        obs_flight.uninstall()
+    assert not obs_flight.active()
+    assert obs_flight.on_crash(RuntimeError("after")) is None
+
+
+def test_flight_crash_in_trainer_leaves_bundle(tmp_path):
+    """Satellite: a trainer step that raises must leave a parseable
+    bundle with the last step records and a registry snapshot, and
+    obs_dump --flight must render it."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init()
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y",
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            momentum=0.9, learning_rate=0.1))
+
+    def reader():
+        yield [(np.ones(4, np.float32), np.ones(1, np.float32))]
+        yield [(np.ones(7, np.float32),  # wrong width: step raises
+                np.ones(1, np.float32))]
+
+    rec = obs_flight.install(out_dir=str(tmp_path))
+    try:
+        with pytest.raises(Exception):
+            trainer.train(reader=reader, num_passes=1,
+                          feeding={"x": 0, "y": 1})
+    finally:
+        obs_flight.uninstall()
+    bundle = rec.last_bundle_path
+    assert bundle and os.path.exists(bundle)
+    doc = obs_dump.validate_flight_bundle(bundle)
+    assert doc["exception"] is not None
+    assert doc["steps"], "no step records before the crash"
+    assert doc["steps"][-1]["trainer"] == "v2"
+    assert doc["registry"]
+    assert any(n["origin"].startswith(("v2/train", "executor/run"))
+               for n in doc["notes"])
+    assert obs_dump.main(["--flight", bundle]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance loop
+# ---------------------------------------------------------------------------
+
+def test_nan_training_full_loop(tmp_path, capsys):
+    """Deliberately-NaN training run end to end:
+    numerics_nonfinite_total increments -> locate_nonfinite names the
+    first offending op -> the induced crash leaves a flight bundle ->
+    obs_dump --flight renders it; xla_* gauges landed from the jit
+    builds along the way."""
+    cost, pg = _train_program()
+    exe = _run_startup()
+    main = fluid.default_main_program()
+    mon = obs_health.NumericsMonitor.for_train_program(
+        main, cost=cost, params_grads=pg).install()
+
+    # 1. the monitored (jitted) run counts the nonfinites on device
+    #    (memory/cost attribution on, as a serving/bench surface would)
+    flags.set_flag("xla_cost_attribution", True)
+    try:
+        outs = exe.run(main, feed={"x": NAN_BATCH},
+                       fetch_list=[cost] + mon.fetch_names)
+    finally:
+        flags.set_flag("xla_cost_attribution", False)
+    assert mon.record(outs[1:])["found_nonfinite"]
+    before = obs_tele.snapshot()
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in before.items())
+
+    # 2. bisection names the first op that went non-finite
+    report = obs_health.locate_nonfinite(main, {"x": NAN_BATCH})
+    assert report["op_type"] == "mul" and report["op_index"] == 0
+
+    # 3. the induced crash (eager check_nan_inf path through the
+    #    executor) writes a flight bundle via the exception hook
+    rec = obs_flight.install(out_dir=str(tmp_path))
+    flags.set_flag("check_nan_inf", True)
+    try:
+        with pytest.raises(NonfiniteError):
+            exe.run(main, feed={"x": NAN_BATCH}, fetch_list=[cost],
+                    eager=True, use_program_cache=False)
+    finally:
+        flags.set_flag("check_nan_inf", False)
+        obs_flight.uninstall()
+    bundle = rec.last_bundle_path
+    assert bundle and os.path.exists(bundle)
+    doc = obs_dump.validate_flight_bundle(bundle)
+    assert doc["exception"]["type"] == "NonfiniteError"
+    # the bundle's registry snapshot carries the numerics counters AND
+    # the per-segment memory/cost attribution
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in doc["registry"].items())
+    assert any(k.startswith("xla_") for k in doc["registry"])
+
+    # 4. the CLI renders it
+    assert obs_dump.main(["--flight", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "NonfiniteError" in out
+
+
+# ---------------------------------------------------------------------------
+# serving: check_numerics + enriched /healthz
+# ---------------------------------------------------------------------------
+
+def _serving_engine(check_numerics):
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.serving import EngineConfig, InferenceEngine
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+        probs = fluid.layers.fc(input=img, size=3, act="softmax")
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    program = fluid_io.prune_program(main, [probs])
+    return InferenceEngine(
+        program, ["img"], [probs], scope=scope,
+        config=EngineConfig(batch_buckets=[2, 4],
+                            check_numerics=check_numerics))
+
+
+def test_engine_check_numerics_counts_nonfinite_outputs():
+    engine = _serving_engine(check_numerics=True)
+    engine.run({"img": np.zeros((2, 8), np.float32)})
+    assert sum(v for k, v in obs_tele.snapshot().items()
+               if k.startswith("numerics_nonfinite_total{")) == 0
+    engine.run({"img": np.full((2, 8), np.nan, np.float32)})
+    flat = obs_tele.snapshot()
+    fetch = engine.fetch_names[0]
+    assert flat["numerics_nonfinite_total{tensor=%s}" % fetch] > 0
+
+
+def test_healthz_reports_registry_signals():
+    from paddle_tpu.serving import InferenceServer
+    from paddle_tpu.serving.server import ServerConfig
+
+    engine = _serving_engine(check_numerics=True)
+    server = InferenceServer(engine, ServerConfig(port=0)).start()
+    host, port = server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/v1/infer", json.dumps(
+            {"inputs": {"img": np.full((2, 8), np.nan).tolist()}}),
+            {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        conn.close()
+
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert body["status"] == "ok"
+        for key in ("queue_depth", "inflight_batches", "requests_total",
+                    "responses_total", "errors_total", "shed_total",
+                    "compile_cache_miss_total",
+                    "numerics_nonfinite_total", "jit_traces_total"):
+            assert key in body, body
+        assert body["responses_total"] >= 1
+        assert body["numerics_nonfinite_total"] > 0
+        assert body["jit_traces_total"] > 0
+        # in-process view agrees with the HTTP one
+        sig = server.health_signals()
+        assert sig["status"] == "ok"
+    finally:
+        server.shutdown()
+    assert server.health_signals()["status"] == "draining"
