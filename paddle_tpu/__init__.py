@@ -15,9 +15,10 @@ from .reader.decorator import batch
 __version__ = "0.1.0"
 
 __all__ = ["reader", "dataset", "batch", "fluid", "v2", "infer",
-           "layer", "image", "obs"]
+           "layer", "image", "obs", "resilience"]
 
 from . import obs  # noqa: E402
+from . import resilience  # noqa: E402
 from . import fluid  # noqa: E402
 from . import v2  # noqa: E402
 from .v2 import layer  # noqa: E402

@@ -13,6 +13,9 @@ import os
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy
+
 __all__ = ["DATA_HOME", "md5file", "download", "fetch_or_none",
            "rng", "synthetic_linear", "synthetic_images",
            "synthetic_sequences"]
@@ -30,27 +33,47 @@ def md5file(path):
     return digest.hexdigest()
 
 
-def download(url, module_name, md5sum=None, save_name=None):
+def _fetch_once(url, tmp, filename, md5sum):
+    """One download attempt: url -> tmp -> rename.  The partial tmp is
+    ALWAYS removed on failure (a stale .part from a died attempt must
+    not shadow-corrupt the next one)."""
+    _faults.check("dataset/download", url=url)
+    from urllib.request import urlopen
+
+    try:
+        with urlopen(url, timeout=30) as resp, open(tmp, "wb") as out:
+            for block in iter(lambda: resp.read(1 << 16), b""):
+                out.write(block)
+        if md5sum is not None and md5file(tmp) != md5sum:
+            # retryable: a truncated/corrupt transfer re-downloads
+            raise IOError("md5 mismatch for %s" % url)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    os.replace(tmp, filename)
+
+
+def download(url, module_name, md5sum=None, save_name=None, retry=None):
     """Fetch `url` into DATA_HOME/<module>/ once; verify md5 when given.
 
-    Raises on network failure — use :func:`fetch_or_none` for the
-    fallback-aware path."""
+    Transient failures (network errors, md5 mismatches from truncated
+    transfers) retry with exponential backoff + full jitter — 3
+    attempts by default, override with a
+    :class:`paddle_tpu.resilience.RetryPolicy`.  Raises after the
+    final attempt — use :func:`fetch_or_none` for the fallback-aware
+    path."""
     cache_dir = os.path.join(DATA_HOME, module_name)
     os.makedirs(cache_dir, exist_ok=True)
     filename = os.path.join(cache_dir,
                             save_name or url.rstrip("/").split("/")[-1])
     if not (os.path.exists(filename)
             and (md5sum is None or md5file(filename) == md5sum)):
-        from urllib.request import urlopen
-
-        tmp = filename + ".part"
-        with urlopen(url, timeout=30) as resp, open(tmp, "wb") as out:
-            for block in iter(lambda: resp.read(1 << 16), b""):
-                out.write(block)
-        if md5sum is not None and md5file(tmp) != md5sum:
-            os.remove(tmp)
-            raise IOError("md5 mismatch for %s" % url)
-        os.replace(tmp, filename)
+        policy = retry or RetryPolicy(max_attempts=3, base_delay=0.25,
+                                      max_delay=5.0,
+                                      name="dataset_download")
+        policy.call(_fetch_once, url, filename + ".part", filename,
+                    md5sum)
     return filename
 
 

@@ -29,6 +29,9 @@ import os
 import threading
 import time
 
+from ..resilience import faults as faults_mod
+from ..resilience.retry import RetryPolicy
+
 __all__ = ["init_multihost", "global_mesh", "process_count",
            "process_index", "ElasticRegistry", "ServiceLease",
            "discover_pservers"]
@@ -104,24 +107,52 @@ class ServiceLease:
     heartbeat runs on its own thread and the framed transport is not
     thread-safe."""
 
-    def __init__(self, client, lease_id, ttl_ms):
+    def __init__(self, client, lease_id, ttl_ms, retry=None,
+                 reconnect=None):
         self._client = client
         self._lease = lease_id
         self._ttl_ms = ttl_ms
+        # `reconnect` (zero-arg -> fresh dedicated client): the native
+        # transport never recovers a failed fd, so a retried beat MUST
+        # run on a new connection or the retry is dead weight
+        self._reconnect = reconnect
+        # transient connection blips within ONE beat retry quickly
+        # instead of dropping the slot; the whole retry budget stays
+        # under one beat interval so a genuinely dead master still
+        # lapses the lease before the TTL reclaims it.  Renew at 1/3
+        # TTL so one missed beat doesn't drop the slot.
+        self._beat_interval = max(0.01, ttl_ms / 3000.0)
+        self._retry = retry or RetryPolicy(
+            max_attempts=3, base_delay=0.01,
+            max_delay=self._beat_interval / 4,
+            deadline=self._beat_interval * 0.9,
+            retryable=(ConnectionError, OSError),
+            name="lease_heartbeat")
         self.lapsed = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._beat, daemon=True)
         self._thread.start()
 
+    def _one_beat(self):
+        faults_mod.check("coordinator/heartbeat")
+        try:
+            return self._client.keep_alive(self._lease)
+        except (ConnectionError, OSError):
+            if self._reconnect is not None:
+                try:
+                    self._client.close()
+                except Exception:
+                    pass
+                self._client = self._reconnect()
+            raise
+
     def _beat(self):
-        # renew at 1/3 TTL so one missed beat doesn't drop the slot
-        interval = max(0.01, self._ttl_ms / 3000.0)
-        while not self._stop.wait(interval):
+        while not self._stop.wait(self._beat_interval):
             try:
-                if not self._client.keep_alive(self._lease):
+                if not self._retry.call(self._one_beat):
                     self.lapsed = True
                     return
-            except ConnectionError:
+            except (ConnectionError, OSError):
                 self.lapsed = True
                 return
 
@@ -146,25 +177,55 @@ class ElasticRegistry:
 
     PS_PREFIX = "/ps/"
 
-    def __init__(self, host, port):
+    def __init__(self, host, port, retry=None):
         from .. import native
 
         self._host, self._port = host, port
+        # registry RPCs retry transient connection failures (master
+        # restarting, dropped frames) before surfacing them
+        self._retry = retry or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=0.5,
+            retryable=(ConnectionError, OSError),
+            name="registry_rpc")
         self._client = native.MasterClient(host, port)
 
     # -- registration ---------------------------------------------------
+    def _register_rpc(self, key, value, ttl_ms):
+        """One register attempt over a FRESH dedicated connection (a
+        retried attempt must not reuse a connection whose framing died
+        mid-RPC).  NOTE a retry after a lost reply can find the key
+        held by our own orphaned lease — the TTL reclaims it within
+        one `ttl_ms`, exactly like the reference's etcd CAS loop."""
+        from .. import native
+
+        faults_mod.check("coordinator/register", key=key)
+        client = native.MasterClient(self._host, self._port)
+        try:
+            lease = client.register(key, value, ttl_ms)
+        except BaseException:
+            client.close()
+            raise
+        if lease is None:
+            client.close()
+            return None
+        return client, lease
+
     def register(self, key, value, ttl_ms=2000):
         """Claim `key`; returns a ServiceLease, or None if a live lease
         holds the key.  The lease heartbeats over its own dedicated
         connection (the framed transport is not thread-safe)."""
-        from .. import native
-
-        client = native.MasterClient(self._host, self._port)
-        lease = client.register(key, value, ttl_ms)
-        if lease is None:
-            client.close()
+        got = self._retry.call(self._register_rpc, key, value, ttl_ms)
+        if got is None:
             return None
-        return ServiceLease(client, lease, ttl_ms)
+        client, lease = got
+
+        def fresh_client():
+            from .. import native
+
+            return native.MasterClient(self._host, self._port)
+
+        return ServiceLease(client, lease, ttl_ms,
+                            reconnect=fresh_client)
 
     def register_pserver(self, endpoint, desired_count, ttl_ms=2000,
                          timeout=30.0):
@@ -186,9 +247,25 @@ class ElasticRegistry:
             time.sleep(min(0.05, ttl_ms / 1000.0))
 
     # -- discovery ------------------------------------------------------
+    def _list_rpc(self):
+        faults_mod.check("coordinator/discover")
+        try:
+            return self._client.list_prefix(self.PS_PREFIX)
+        except (ConnectionError, OSError):
+            # the native transport never recovers a failed fd: swap in
+            # a fresh connection so the NEXT retry attempt can succeed
+            from .. import native
+
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = native.MasterClient(self._host, self._port)
+            raise
+
     def pservers(self):
         """{slot: endpoint} of live pservers."""
-        entries = self._client.list_prefix(self.PS_PREFIX)
+        entries = self._retry.call(self._list_rpc)
         return {int(k[len(self.PS_PREFIX):]): v
                 for k, v in entries.items()}
 

@@ -61,6 +61,17 @@ class LossScaler:
     def scale(self):
         return self._scale
 
+    def set_scale(self, value):
+        """Restore the scale directly (checkpoint resume — the
+        resilience supervisor round-trips it through the snapshot
+        meta); clamps to [min_scale, max_scale], resets the clean-step
+        streak, and republishes the gauge."""
+        self._scale = min(self.max_scale,
+                          max(self.min_scale, float(value)))
+        self._good_steps = 0
+        self._publish()
+        return self._scale
+
     def update(self, found_nonfinite):
         """One step's verdict: overflow halves the scale (and the step
         should be skipped by the caller), a clean streak of

@@ -31,6 +31,8 @@ from . import framework
 from .io import is_persistable, _save_one, _load_one
 from ..core.ragged import RaggedTensor
 from ..core.scope import global_scope
+from ..resilience import faults as faults_mod
+from ..resilience.retry import RetryPolicy
 
 __all__ = ["CheckpointSaver", "load_checkpoint", "latest_checkpoint"]
 
@@ -84,11 +86,22 @@ class CheckpointSaver:
     """
 
     def __init__(self, root, main_program=None, interval_secs=600,
-                 max_to_keep=3):
+                 max_to_keep=3, var_names=None, write_retry=None):
         self.root = root
         self.interval_secs = interval_secs
         self.max_to_keep = max_to_keep
         self._program = main_program
+        # var_names overrides program-persistable discovery: callers
+        # whose state never lives in a Program (ParallelTrainer's
+        # sharded state dict via the supervisor) name it explicitly
+        self._explicit_vars = (list(var_names) if var_names is not None
+                               else None)
+        # a snapshot write retries transient I/O (flaky NFS/GCS fuse)
+        # before surfacing the error on wait(); the attempts are
+        # idempotent — same files, rewritten in place
+        self._write_retry = write_retry or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=0.5,
+            name="checkpoint_write")
         # the first interval is honored from construction time: a just-
         # resumed run should not immediately re-snapshot what it loaded
         self._last_time = time.time()
@@ -96,6 +109,8 @@ class CheckpointSaver:
         self._error = None
 
     def _var_names(self):
+        if self._explicit_vars is not None:
+            return list(self._explicit_vars)
         program = self._program or framework.default_main_program()
         return [v.name for v in program.list_vars() if is_persistable(v)]
 
@@ -145,21 +160,51 @@ class CheckpointSaver:
 
     def _write(self, snap, values):
         try:
-            os.makedirs(snap, exist_ok=True)
-            manifest = {}
-            for name, value in values.items():
-                _save_one(snap, name, value)  # fluid.io npz layout
-                fname = name.replace("/", "_") + ".npz"
-                manifest[name] = {
-                    "file": fname,
-                    "crc32": _crc_file(os.path.join(snap, fname))}
-            fd, tmp = tempfile.mkstemp(dir=snap)
-            with os.fdopen(fd, "w") as f:
-                json.dump(manifest, f)
-            os.rename(tmp, os.path.join(snap, _MANIFEST))
+            self._write_retry.call(self._write_once, snap, values)
             self._gc()
         except BaseException as e:  # surfaced on the next wait()/save()
             self._error = e
+
+    @staticmethod
+    def _fsync_path(path):
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _write_once(self, snap, values):
+        faults_mod.check("checkpoint/write", snap=snap)
+        os.makedirs(snap, exist_ok=True)
+        manifest = {}
+        for name, value in values.items():
+            _save_one(snap, name, value)  # fluid.io npz layout
+            fname = name.replace("/", "_") + ".npz"
+            path = os.path.join(snap, fname)
+            # fsync BEFORE the manifest references the file: a
+            # power-loss torn write must not pass CRC just because the
+            # page cache flushed the manifest but not the tensors
+            self._fsync_path(path)
+            manifest[name] = {"file": fname, "crc32": _crc_file(path)}
+        fd, tmp = tempfile.mkstemp(dir=snap)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, os.path.join(snap, _MANIFEST))
+        except BaseException:
+            # any failure before the rename lands must not strand the
+            # mkstemp file — _gc only sweeps whole manifest-less
+            # snapshot DIRECTORIES, and a write retry would otherwise
+            # accumulate one orphan per attempt
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        # durability of the rename itself
+        self._fsync_path(snap)
 
     def _gc(self):
         # runs on the writer thread AFTER our own manifest landed and

@@ -37,6 +37,7 @@ from ..obs import health as obs_health
 from ..obs import telemetry as obs_tele
 from ..obs import trace as obs_trace
 from ..ops import registry as op_registry
+from ..resilience import faults as faults_mod
 from ..utils import flags
 from . import framework
 from . import profiler as profiler_mod
@@ -593,6 +594,9 @@ class Executor:
                        for f in fetch_list]
 
         obs_tele.on_executor_run()
+        # chaos hook: injected transient IOError/latency on the run
+        # dispatch path (one None check when no fault plan is active)
+        faults_mod.check("executor/run")
         run_span = obs_trace.span("executor/run", cat="executor",
                                   feeds=len(feed),
                                   fetches=len(fetch_names))

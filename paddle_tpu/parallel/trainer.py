@@ -193,6 +193,26 @@ class ParallelTrainer:
     def fetch_state(self, name):
         return np.asarray(self.state[name])
 
+    # -- supervisor integration ---------------------------------------------
+    def dump_state_to(self, scope):
+        """Host copies of the sharded state into `scope` (called by
+        the resilience supervisor right before a checkpoint save)."""
+        for name, val in self.state.items():
+            scope.set(name, np.asarray(val))
+
+    def load_state_from(self, scope):
+        """Re-place checkpointed host values onto the mesh with the
+        step function's shardings (after a supervisor restore)."""
+        restored = {}
+        for name in self.state:
+            val = scope.get(name)
+            if val is None:
+                raise KeyError("checkpoint is missing state var %r"
+                               % name)
+            restored[name] = jax.device_put(np.asarray(val),
+                                            self._shardings[name])
+        self.state = restored
+
 
 def jnp_asarray(v):
     import jax.numpy as jnp

@@ -14,6 +14,8 @@ executes, so the accelerator never waits on the input pipeline.
 import queue
 import threading
 
+from ..resilience import faults as faults_mod
+
 __all__ = ["device_prefetch", "host_prefetch"]
 
 _END = object()
@@ -39,6 +41,9 @@ def _pump(reader_fn, q, transform, stop):
 
     try:
         for item in reader_fn():
+            # chaos hook: an injected IOError here exercises the
+            # worker->consumer failure path below (free when off)
+            faults_mod.check("reader/pump")
             if not offer(transform(item) if transform else item):
                 return
         offer(_END)

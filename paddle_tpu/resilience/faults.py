@@ -1,0 +1,242 @@
+"""Seeded, deterministic fault injection behind named points.
+
+Chaos testing only proves something when the chaos is reproducible:
+the same seed and the same plan must fire the same faults at the same
+call counts every run (the reference's cluster tests kill pservers at
+fixed points for the same reason — go/master/service_internal_test.go).
+So injection here is a *plan*: an ordered list of `FaultSpec`s, each
+bound to one named point, firing either on exact call counts
+(`after`/`times` — fully deterministic) or with a seeded probability.
+
+Instrumented code calls `faults.check("point")` — a single
+module-global None check when no plan is enabled, so the hooks are free
+in production.  Points currently threaded through the stack:
+
+    executor/run         fluid/executor.py  Executor.run dispatch
+    checkpoint/write     fluid/checkpoint.py  snapshot write attempt
+    reader/pump          reader/prefetch.py  one pumped item
+    dataset/download     dataset/common.py  one download attempt
+    coordinator/register distributed/coordinator.py  register RPC
+    coordinator/heartbeat  ..  one keep-alive RPC
+    coordinator/discover   ..  one list_prefix RPC
+    serving/run          serving/engine.py  one engine request
+    supervisor/step      resilience/supervisor.py  one supervised step
+
+Fault kinds:
+
+    io_error   raise `InjectedIOError` (an IOError — retry policies
+               treat it as transient, exactly like a flaky disk/NIC)
+    latency    sleep `latency_s` then continue
+    preempt    deliver a real signal (SIGTERM by default) to the
+               process — the supervisor's preemption hook sees exactly
+               what a preemptible-pool reclaim sends
+    nonfinite  no side effect here; `check` returns the fired spec and
+               the caller simulates the blowup (the supervisor replaces
+               the step loss with NaN)
+
+Every fired fault increments `faults_injected_total{point,kind}` and
+emits a `fault_injected` trace instant, so a chaos run's artifacts
+(flight bundles, BENCH metrics blobs) show exactly which faults fired
+and when.
+"""
+
+import random
+import signal as signal_mod
+import threading
+import time
+
+from ..obs import registry as registry_mod
+from ..obs import trace as trace_mod
+
+__all__ = ["FaultSpec", "FaultPlan", "InjectedIOError", "enable",
+           "disable", "active", "get_plan", "inject", "check",
+           "fired_counts"]
+
+KINDS = ("io_error", "latency", "preempt", "nonfinite")
+
+
+class InjectedIOError(IOError):
+    """A deliberately injected transient I/O failure."""
+
+
+class FaultSpec:
+    """One planned fault at one point.
+
+    after:       skip the first `after` matching calls (0 = eligible
+                 immediately).
+    times:       fire at most this many times (None = unbounded).
+    probability: when set, each eligible call fires with this seeded
+                 probability instead of firing deterministically.
+    latency_s:   sleep duration for kind="latency".
+    signum:      signal delivered for kind="preempt".
+    """
+
+    def __init__(self, point, kind, after=0, times=1, probability=None,
+                 latency_s=0.05, signum=signal_mod.SIGTERM,
+                 message=None):
+        if kind not in KINDS:
+            raise ValueError("unknown fault kind %r (one of %s)"
+                             % (kind, ", ".join(KINDS)))
+        self.point = str(point)
+        self.kind = kind
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.probability = probability
+        self.latency_s = float(latency_s)
+        self.signum = signum
+        self.message = message or (
+            "injected %s fault at %r" % (kind, point))
+        self.calls = 0   # matching calls seen
+        self.fired = 0   # times actually fired
+
+    def _should_fire(self, rng):
+        self.calls += 1
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.calls <= self.after:
+            return False
+        if self.probability is not None:
+            return rng.random() < self.probability
+        return True
+
+    def __repr__(self):
+        return ("FaultSpec(point=%r, kind=%r, after=%d, times=%r, "
+                "fired=%d)" % (self.point, self.kind, self.after,
+                               self.times, self.fired))
+
+
+class FaultPlan:
+    """An ordered set of FaultSpecs sharing one seeded RNG.
+
+    Thread-safe: injection points are hit from pump threads, heartbeat
+    threads and the serving request path concurrently; the per-spec
+    call counters and the RNG draw happen under one lock so a plan
+    replays identically regardless of wall-clock interleaving *per
+    point* (cross-point ordering is the caller's workload's business).
+    """
+
+    def __init__(self, seed=0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._specs = []
+        self._lock = threading.Lock()
+
+    def inject(self, point, kind, **kw):
+        """Add a FaultSpec to the plan; returns it (its `.fired` count
+        is live — chaos harnesses assert on it)."""
+        spec = FaultSpec(point, kind, **kw)
+        with self._lock:
+            self._specs.append(spec)
+        return spec
+
+    def specs(self, point=None):
+        with self._lock:
+            return [s for s in self._specs
+                    if point is None or s.point == point]
+
+    def fired_counts(self):
+        """{(point, kind): fired} over the whole plan."""
+        out = {}
+        with self._lock:
+            for s in self._specs:
+                key = (s.point, s.kind)
+                out[key] = out.get(key, 0) + s.fired
+        return out
+
+    def check(self, point, **context):
+        """Evaluate `point` against the plan.  Raises for io_error,
+        sleeps for latency, signals for preempt; returns the fired
+        spec (nonfinite and the non-raising kinds) or None."""
+        fired = None
+        with self._lock:
+            for spec in self._specs:
+                if spec.point != point:
+                    continue
+                if spec._should_fire(self._rng):
+                    spec.fired += 1
+                    fired = spec
+                    break
+        if fired is None:
+            return None
+        self._record(fired, context)
+        if fired.kind == "io_error":
+            raise InjectedIOError(fired.message)
+        if fired.kind == "latency":
+            time.sleep(fired.latency_s)
+        elif fired.kind == "preempt":
+            # a real signal, exactly like a preemptible-pool reclaim:
+            # the Python-level handler (the supervisor's hook) runs in
+            # the main thread at the next bytecode boundary
+            signal_mod.raise_signal(fired.signum)
+        return fired
+
+    @staticmethod
+    def _record(spec, context):
+        registry_mod.get_registry().counter(
+            "faults_injected_total",
+            "deliberately injected faults, by point and kind",
+            labelnames=("point", "kind")) \
+            .labels(point=spec.point, kind=spec.kind).inc()
+        trace_mod.instant("fault_injected", cat="fault",
+                          point=spec.point, kind=spec.kind,
+                          **{k: str(v) for k, v in context.items()})
+        # a chaos run that later crashes should show its injected
+        # faults in the post-mortem bundle's notes
+        from ..obs import flight as flight_mod
+
+        rec = flight_mod.get_recorder()
+        if rec is not None:
+            rec.note("faults", point=spec.point, kind=spec.kind,
+                     fired=spec.fired)
+
+
+# ---------------------------------------------------------------------------
+# process-wide plan — one None check when chaos is off
+# ---------------------------------------------------------------------------
+
+_plan = None
+
+
+def enable(seed=0):
+    """Activate a fresh process-wide FaultPlan (replacing any previous
+    one); returns it."""
+    global _plan
+    _plan = FaultPlan(seed=seed)
+    return _plan
+
+
+def disable():
+    """Deactivate fault injection; returns the old plan (or None)."""
+    global _plan
+    plan, _plan = _plan, None
+    return plan
+
+
+def active():
+    return _plan is not None
+
+
+def get_plan():
+    return _plan
+
+
+def inject(point, kind, **kw):
+    """Add a fault to the active plan (enable() first)."""
+    if _plan is None:
+        raise RuntimeError("no fault plan active; call faults.enable()")
+    return _plan.inject(point, kind, **kw)
+
+
+def check(point, **context):
+    """The instrumentation hook: free (one None check) when chaos is
+    off, else evaluates the active plan at `point`."""
+    plan = _plan
+    if plan is None:
+        return None
+    return plan.check(point, **context)
+
+
+def fired_counts():
+    """{(point, kind): fired} for the active plan ({} when off)."""
+    plan = _plan
+    return plan.fired_counts() if plan is not None else {}

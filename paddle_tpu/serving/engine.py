@@ -289,7 +289,11 @@ class InferenceEngine:
 
         from ..obs import flight as obs_flight
         from ..obs import trace as obs_trace
+        from ..resilience import faults as faults_mod
 
+        # chaos hook: injected transient IOError/latency on the
+        # request path (free when no fault plan is active)
+        faults_mod.check("serving/run")
         with self._lock, obs_trace.span("serving/engine_run",
                                         cat="serving") as run_span:
             t0 = time.perf_counter()
@@ -362,7 +366,12 @@ class InferenceEngine:
         # is a counting override, so concurrent warmups in one process
         # can't race a flag save/restore.
         from ..obs import health as obs_health
+        from ..resilience.retry import RetryPolicy
 
+        # a transient I/O hiccup during a warmup compile must not kill
+        # the deploy: each bucket retries before the failure surfaces
+        retry = RetryPolicy(max_attempts=3, base_delay=0.05,
+                            max_delay=1.0, name="serving_warmup")
         saved_metrics, self.metrics = self.metrics, None
         warmed = 0
         try:
@@ -370,7 +379,7 @@ class InferenceEngine:
                 for bucket in self.config.batch_buckets:
                     feeds = {n: self._synthetic_feed(m, bucket)
                              for n, m in self._feed_meta.items()}
-                    self.run(feeds)
+                    retry.call(self.run, feeds)
                     warmed += 1
         finally:
             self.metrics = saved_metrics

@@ -88,6 +88,56 @@ class SGD:
                 .install()
         return self._health_monitor
 
+    def step_runner(self, feeding=None):
+        """Return `step(data) -> float cost`: one forward/backward/
+        update through the executor, with the same telemetry, numerics
+        monitoring and flight hooks as `train()`.  This is the
+        `resilience.TrainingSupervisor`'s entry into the v2 loop — the
+        supervisor owns batching/epochs so it can checkpoint, skip
+        consumed batches on resume, and roll back nonfinite steps."""
+        feeder = self._feeder(feeding)
+        fetch = [self._cost] + list(self._extra)
+        n_user = len(fetch)
+        monitor = self._numerics_monitor()
+        if monitor is not None:
+            fetch = fetch + monitor.fetch_names
+        counter = [0]
+
+        def step(data):
+            feed = None
+            try:
+                feed = feeder.feed(data)
+                with obs_tele.step("v2", examples=len(data),
+                                   batch_id=counter[0]):
+                    outs = self._exe.run(self._main_program, feed=feed,
+                                         fetch_list=fetch)
+            except Exception as exc:
+                obs_flight.on_crash(
+                    exc, origin="v2/supervised_step",
+                    batch_id=counter[0],
+                    feeds=obs_flight.describe_feeds(feed)
+                    if feed else None)
+                raise
+            summary = None
+            if monitor is not None:
+                summary = monitor.record(dict(zip(monitor.fetch_names,
+                                                  outs[n_user:])))
+                outs = outs[:n_user]
+            cost = float(np.asarray(outs[0]).reshape(-1)[0])
+            obs_tele.set_gauge("trainer_last_loss", cost, trainer="v2")
+            if obs_flight.active():
+                obs_flight.record_step("v2", counter[0], feeds=feed,
+                                       loss=cost)
+            counter[0] += 1
+            if summary is not None and summary["found_nonfinite"]:
+                # grads can go nonfinite while the loss still reads
+                # finite — surface the monitor's verdict so the
+                # supervisor rolls back on it
+                return float("nan")
+            return cost
+
+        return step
+
     def train(self, reader, num_passes=1, event_handler=None,
               feeding=None, save_dir=None):
         """save_dir: when set, parameters are written to

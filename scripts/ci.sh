@@ -31,6 +31,9 @@ timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
 echo "[ci] obs selftest (traced train+serve, NaN health+flight loop, Perfetto JSON, unified /metrics) ..."
 timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 
+echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."
+timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
+
 echo "[ci] driver entry points ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
     python bench.py

@@ -218,6 +218,59 @@ def test_prefetch_early_abandon_stops_worker():
     assert len(produced) < 100  # it stopped early, not after 10k
 
 
+def test_pump_exception_propagates_and_thread_exits():
+    """Regression: an exception raised inside the _pump worker (from
+    the reader OR the transform) must reach the consumer — not be
+    swallowed — and the pump thread must exit instead of leaking."""
+    import threading
+    from paddle_tpu.reader import host_prefetch
+
+    before = threading.active_count()
+
+    def boom_mid_stream():
+        yield 1
+        yield 2
+        raise IOError("disk fell over")
+
+    it = host_prefetch(boom_mid_stream, depth=1)()
+    assert next(it) == 1
+    with pytest.raises(IOError, match="disk fell over"):
+        list(it)
+
+    def bad_transform(item):
+        raise ValueError("transform died")
+
+    it2 = host_prefetch(lambda: iter(range(5)), depth=2,
+                        transform=bad_transform)()
+    with pytest.raises(ValueError, match="transform died"):
+        next(it2)
+
+    # both pump threads must wind down (not block in q.put forever)
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+
+def test_pump_injected_fault_reaches_consumer():
+    """The chaos hook inside _pump surfaces like any reader failure:
+    the consumer sees the injected IOError and can restart the epoch
+    (what resilience.TrainingSupervisor does)."""
+    from paddle_tpu.reader import host_prefetch
+    from paddle_tpu.resilience import faults
+
+    faults.enable(seed=0)
+    faults.inject("reader/pump", "io_error", after=2, times=1)
+    it = host_prefetch(lambda: iter(range(10)), depth=2)()
+    got = [next(it), next(it)]
+    with pytest.raises(faults.InjectedIOError):
+        list(it)
+    assert got == [0, 1]
+    # one-shot: a fresh epoch streams clean
+    assert list(host_prefetch(lambda: iter(range(4)), depth=2)()) \
+        == [0, 1, 2, 3]
+
+
 def test_device_prefetch_leaves_int64_on_host():
     """int64 narrowing depends on the target var dtype, which only the
     executor knows — device_prefetch must NOT device_put int64 (JAX

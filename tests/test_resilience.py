@@ -1,0 +1,457 @@
+"""paddle_tpu.resilience: retry policies, circuit breaker, the seeded
+fault-injection registry, and the retry wiring into dataset downloads,
+checkpoint writes and serving warmup."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.obs import telemetry as obs_tele
+from paddle_tpu.resilience import faults, retry
+from paddle_tpu.resilience.retry import (AttemptTimeout, CircuitBreaker,
+                                         CircuitOpenError, RetryPolicy)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise IOError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.0, name="t")
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+    snap = obs_tele.snapshot()
+    assert snap.get("retries_total{op=t}") == 2
+
+
+def test_retry_exhausts_and_reraises():
+    policy = RetryPolicy(max_attempts=2, base_delay=0.0, name="boom")
+
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        policy.call(always)
+    snap = obs_tele.snapshot()
+    assert snap.get("retry_exhausted_total{op=boom}") == 1
+
+
+def test_retry_nonretryable_propagates_immediately():
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5, base_delay=0.0).call(bug)
+    assert len(calls) == 1  # no retries on non-retryable
+
+
+def test_retry_backoff_full_jitter_bounds():
+    import random
+
+    policy = RetryPolicy(base_delay=0.1, max_delay=1.0,
+                         rng=random.Random(0))
+    for attempt in range(1, 8):
+        cap = min(1.0, 0.1 * (2 ** (attempt - 1)))
+        for _ in range(20):
+            d = policy.backoff(attempt)
+            assert 0 <= d <= cap
+    nojit = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=False)
+    assert nojit.backoff(1) == 0.1
+    assert nojit.backoff(5) == 1.0  # capped
+
+
+def test_retry_overall_deadline_stops_sleeping_past_budget():
+    slept = []
+
+    def never():
+        raise IOError("x")
+
+    policy = RetryPolicy(max_attempts=100, base_delay=10.0,
+                         jitter=False, deadline=0.5,
+                         sleep=slept.append)
+    with pytest.raises(IOError):
+        policy.call(never)
+    # first backoff (10s) would blow the 0.5s budget: no sleep at all
+    assert slept == []
+
+
+def test_retry_attempt_timeout_retries_hung_call():
+    calls = []
+
+    def hangs_once():
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(5)
+        return "done"
+
+    policy = RetryPolicy(max_attempts=2, base_delay=0.0,
+                         attempt_timeout=0.2)
+    assert policy.call(hangs_once) == "done"
+    assert len(calls) == 2
+
+
+def test_retry_attempt_timeout_exhausted_raises_attempt_timeout():
+    policy = RetryPolicy(max_attempts=1, attempt_timeout=0.05)
+    with pytest.raises(AttemptTimeout):
+        policy.call(time.sleep, 5)
+
+
+def test_retry_wrap_decorator():
+    calls = []
+
+    @RetryPolicy(max_attempts=2, base_delay=0.0).wrap
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("t")
+        return 7
+
+    assert flaky() == 7
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+def test_circuit_opens_fast_fails_and_recovers():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, reset_timeout=10.0,
+                        name="dep", clock=lambda: now[0])
+
+    def boom():
+        raise IOError("down")
+
+    for _ in range(2):
+        with pytest.raises(IOError):
+            cb.call(boom)
+    assert cb.state == cb.OPEN
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: 1)  # fast fail, fn not called
+    # cooldown lapses -> half-open probe; success closes
+    now[0] = 11.0
+    assert cb.call(lambda: 42) == 42
+    assert cb.state == cb.CLOSED
+    snap = obs_tele.snapshot()
+    assert snap.get("circuit_opened_total{breaker=dep}") == 1
+    assert snap.get("circuit_state{breaker=dep}") == 0
+
+
+def test_circuit_failed_probe_reopens():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout=5.0,
+                        name="dep2", clock=lambda: now[0])
+    with pytest.raises(IOError):
+        cb.call(lambda: (_ for _ in ()).throw(IOError()))
+    now[0] = 6.0
+    with pytest.raises(IOError):
+        cb.call(lambda: (_ for _ in ()).throw(IOError()))  # probe fails
+    assert not cb.allow()  # re-armed, still open
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_faults_deterministic_after_times_and_counters():
+    plan = faults.enable(seed=3)
+    spec = plan.inject("p/x", "io_error", after=2, times=2)
+    assert faults.check("p/x") is None
+    assert faults.check("p/x") is None
+    for _ in range(2):
+        with pytest.raises(faults.InjectedIOError):
+            faults.check("p/x")
+    assert faults.check("p/x") is None  # times exhausted
+    assert spec.fired == 2
+    assert faults.fired_counts() == {("p/x", "io_error"): 2}
+    snap = obs_tele.snapshot()
+    assert snap.get("faults_injected_total{kind=io_error,point=p/x}") \
+        == 2
+
+
+def test_faults_probability_is_seeded_and_reproducible():
+    def trial():
+        plan = faults.FaultPlan(seed=42)
+        plan.inject("p/y", "nonfinite", probability=0.5, times=None)
+        return [plan.check("p/y") is not None for _ in range(32)]
+
+    a, b = trial(), trial()
+    assert a == b
+    assert any(a) and not all(a)
+
+
+def test_faults_latency_sleeps():
+    faults.enable(seed=0)
+    faults.inject("p/slow", "latency", latency_s=0.1)
+    t0 = time.perf_counter()
+    fired = faults.check("p/slow")
+    assert fired is not None and fired.kind == "latency"
+    assert time.perf_counter() - t0 >= 0.09
+
+
+def test_faults_off_is_free_and_check_noop():
+    assert not faults.active()
+    assert faults.check("anything") is None
+    assert faults.fired_counts() == {}
+    with pytest.raises(RuntimeError):
+        faults.inject("p", "io_error")  # no plan enabled
+
+
+def test_faults_unknown_kind_rejected():
+    plan = faults.FaultPlan()
+    with pytest.raises(ValueError):
+        plan.inject("p", "meteor_strike")
+
+
+def test_executor_run_fault_point():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    loss = fluid.layers.mean(x=fluid.layers.fc(input=x, size=3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    faults.enable(seed=0)
+    faults.inject("executor/run", "io_error", times=1)
+    with pytest.raises(faults.InjectedIOError):
+        exe.run(fluid.default_main_program(),
+                feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+    # one-shot: the next run goes through
+    out, = exe.run(fluid.default_main_program(),
+                   feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[loss])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+# ---------------------------------------------------------------------------
+# dataset download: retry + partial-tmp cleanup
+# ---------------------------------------------------------------------------
+
+def test_download_retries_transient_faults(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"hello resilience")
+    url = "file://" + str(src)
+
+    faults.enable(seed=0)
+    faults.inject("dataset/download", "io_error", times=2)
+    got = common.download(url, "unit",
+                          retry=RetryPolicy(max_attempts=3,
+                                            base_delay=0.0,
+                                            name="dl"))
+    assert open(got, "rb").read() == b"hello resilience"
+    assert not os.path.exists(got + ".part")
+    snap = obs_tele.snapshot()
+    assert snap.get("retries_total{op=dl}") == 2
+
+
+def test_download_exhausted_leaves_no_partial(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"x")
+    url = "file://" + str(src)
+    faults.enable(seed=0)
+    faults.inject("dataset/download", "io_error", times=None)
+    with pytest.raises(IOError):
+        common.download(url, "unit",
+                        retry=RetryPolicy(max_attempts=3,
+                                          base_delay=0.0))
+    cache_dir = tmp_path / "unit"
+    leftovers = [p for p in os.listdir(cache_dir)] \
+        if cache_dir.exists() else []
+    assert not any(p.endswith(".part") for p in leftovers), leftovers
+
+
+def test_download_md5_mismatch_removes_tmp_and_retries(tmp_path,
+                                                       monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"data")
+    url = "file://" + str(src)
+    with pytest.raises(IOError, match="md5 mismatch"):
+        common.download(url, "unit", md5sum="0" * 32,
+                        retry=RetryPolicy(max_attempts=2,
+                                          base_delay=0.0))
+    cache_dir = tmp_path / "unit"
+    assert not any(p.endswith(".part")
+                   for p in os.listdir(cache_dir)), \
+        os.listdir(cache_dir)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint write: retry, fsync-path orphan cleanup
+# ---------------------------------------------------------------------------
+
+def _toy_program():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    loss = fluid.layers.mean(x=fluid.layers.fc(input=x, size=3))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return loss
+
+
+def test_checkpoint_write_retries_injected_fault(tmp_path):
+    from paddle_tpu.fluid.checkpoint import (CheckpointSaver,
+                                             latest_checkpoint)
+
+    _toy_program()
+    faults.enable(seed=0)
+    faults.inject("checkpoint/write", "io_error", times=1)
+    saver = CheckpointSaver(str(tmp_path / "ck"), interval_secs=0)
+    saver.save(1)
+    saver.wait()  # the injected IOError was retried, not surfaced
+    assert latest_checkpoint(str(tmp_path / "ck")) is not None
+    assert faults.fired_counts() == {("checkpoint/write",
+                                      "io_error"): 1}
+
+
+def test_checkpoint_manifest_failure_leaves_no_orphan_tmp(tmp_path,
+                                                          monkeypatch):
+    from paddle_tpu.fluid import checkpoint as ckpt_mod
+
+    _toy_program()
+    root = str(tmp_path / "ck")
+    saver = ckpt_mod.CheckpointSaver(
+        root, interval_secs=0,
+        write_retry=RetryPolicy(max_attempts=1, base_delay=0.0))
+
+    def bad_dump(obj, fh, **kw):
+        raise IOError("manifest serialization died")
+
+    monkeypatch.setattr(ckpt_mod.json, "dump", bad_dump)
+    snap = saver.save(1)
+    with pytest.raises(IOError, match="manifest"):
+        saver.wait()
+    # the mkstemp tmp was cleaned up: only var .npz files remain
+    leftovers = [f for f in os.listdir(snap)
+                 if not f.endswith(".npz")]
+    assert leftovers == [], leftovers
+    assert ckpt_mod.latest_checkpoint(root) is None  # torn, invisible
+
+
+def test_checkpoint_explicit_var_names(tmp_path):
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.fluid.checkpoint import (CheckpointSaver,
+                                             load_checkpoint)
+
+    _toy_program()
+    global_scope().set("extra_state", np.arange(4, dtype=np.float32))
+    saver = CheckpointSaver(str(tmp_path / "ck"), interval_secs=0,
+                            var_names=["extra_state"])
+    saver.save(5)
+    saver.wait()
+    global_scope().set("extra_state", None)
+    assert load_checkpoint(str(tmp_path / "ck")) == 5
+    np.testing.assert_array_equal(
+        np.asarray(global_scope().get("extra_state")),
+        np.arange(4, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# coordinator: heartbeat retry over a fake client
+# ---------------------------------------------------------------------------
+
+class _FakeLeaseClient:
+    def __init__(self, fail_beats=0):
+        self.fail_beats = fail_beats
+        self.beats = 0
+        self.closed = False
+        self.unregistered = False
+
+    def keep_alive(self, lease):
+        self.beats += 1
+        if self.fail_beats > 0:
+            self.fail_beats -= 1
+            raise ConnectionError("blip")
+        return True
+
+    def unregister(self, lease):
+        self.unregistered = True
+
+    def close(self):
+        self.closed = True
+
+
+def test_lease_heartbeat_survives_transient_blip():
+    from paddle_tpu.distributed.coordinator import ServiceLease
+
+    client = _FakeLeaseClient(fail_beats=1)
+    lease = ServiceLease(client, lease_id=1, ttl_ms=120)
+    deadline = time.time() + 3
+    while client.beats < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert not lease.lapsed  # one blip was retried, not fatal
+    lease.release()
+    assert client.unregistered and client.closed
+
+
+def test_lease_heartbeat_lapses_on_persistent_failure():
+    from paddle_tpu.distributed.coordinator import ServiceLease
+
+    client = _FakeLeaseClient(fail_beats=10 ** 6)
+    lease = ServiceLease(client, lease_id=1, ttl_ms=120)
+    deadline = time.time() + 3
+    while not lease.lapsed and time.time() < deadline:
+        time.sleep(0.01)
+    assert lease.lapsed
+
+
+# ---------------------------------------------------------------------------
+# serving: request-path fault point + warmup retry
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(batch_buckets=(2,)):
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.serving import EngineConfig, InferenceEngine
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        probs = fluid.layers.fc(input=img, size=3, act="softmax")
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    program = fluid_io.prune_program(main, [probs])
+    return InferenceEngine(
+        program, ["img"], [probs], scope=scope,
+        config=EngineConfig(batch_buckets=list(batch_buckets)))
+
+
+def test_serving_run_fault_point_raises():
+    engine = _tiny_engine()
+    faults.enable(seed=0)
+    faults.inject("serving/run", "io_error", times=1)
+    with pytest.raises(faults.InjectedIOError):
+        engine.run({"img": np.zeros((2, 4), np.float32)})
+    out = engine.run({"img": np.zeros((2, 4), np.float32)})
+    assert np.asarray(out[0]).shape[0] == 2
+
+
+def test_serving_warmup_retries_through_injected_fault():
+    engine = _tiny_engine(batch_buckets=(1, 2))
+    faults.enable(seed=0)
+    faults.inject("serving/run", "io_error", times=1)
+    assert engine.warmup() == 2  # both buckets warmed despite the fault
+    snap = obs_tele.snapshot()
+    assert snap.get("retries_total{op=serving_warmup}") == 1

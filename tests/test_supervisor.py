@@ -1,0 +1,329 @@
+"""TrainingSupervisor: preemption-safe checkpoints, auto-resume with
+batch skip, nonfinite rollback, restart budget — resume semantics must
+reproduce an uninterrupted run step for step on the same seed."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.reader import host_prefetch
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.supervisor import (Preempted,
+                                              RestartBudgetExceeded,
+                                              SUPERVISOR_META,
+                                              TrainingSupervisor)
+
+
+def _build_sgd(lr=0.1):
+    paddle.init()
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y",
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    return paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=lr))
+
+
+def _batches(n=6, batch=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return [[(rs.rand(4).astype("f"), rs.rand(1).astype("f"))
+             for _ in range(batch)] for _ in range(n)]
+
+
+def _reader_fn(batches):
+    def reader():
+        for b in batches:
+            yield b
+
+    return reader
+
+
+def _params_of(sgd):
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.fluid.io import is_persistable
+
+    out = {}
+    for v in sgd._main_program.list_vars():
+        if is_persistable(v):
+            val = global_scope().get(v.name)
+            if val is not None:
+                out[v.name] = np.array(val)
+    return out
+
+
+def _clean_run(tmp_path, fresh_programs, epochs=2):
+    """Reference trajectory on a fresh workspace; returns
+    (losses-by-step, sorted final param arrays)."""
+    sgd = _build_sgd()
+    losses = {}
+    sup = TrainingSupervisor(str(tmp_path / "clean"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=1)
+    sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+            _reader_fn(_batches()), num_epochs=epochs,
+            on_step=lambda s, l: losses.__setitem__(s, l))
+    params = _params_of(sgd)
+    return losses, [params[k] for k in sorted(params)]
+
+
+def _reset_workspace():
+    # same reset the conftest fixtures apply, but mid-test: the second
+    # training run must not see the first one's programs/scope
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.v2 import layer as v2_layer
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    scope_mod._global_scope = scope_mod.Scope()
+    v2_layer._reset_data_layers()
+
+
+def test_preempted_resume_matches_uninterrupted(tmp_path,
+                                                fresh_programs):
+    """Kill mid-epoch (injected SIGTERM), auto-resume, and the loss
+    trajectory + final params match an uninterrupted run on the same
+    seed, step for step."""
+    clean_losses, clean_params = _clean_run(tmp_path, fresh_programs)
+
+    _reset_workspace()
+    sgd = _build_sgd()
+    faults.enable(seed=0)
+    faults.inject("supervisor/step", "preempt", after=3, times=1)
+    losses = {}
+    sup = TrainingSupervisor(str(tmp_path / "chaos"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=1)
+    out = sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+                  _reader_fn(_batches()), num_epochs=2,
+                  on_step=lambda s, l: losses.__setitem__(s, l))
+    assert out["restarts"] == 1
+    assert faults.fired_counts() == {("supervisor/step",
+                                      "preempt"): 1}
+    assert sorted(losses) == sorted(clean_losses)
+    for step in clean_losses:
+        assert losses[step] == pytest.approx(clean_losses[step],
+                                             abs=1e-12), step
+    params = _params_of(sgd)
+    for got, want in zip([params[k] for k in sorted(params)],
+                         clean_params):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_preempt_writes_urgent_checkpoint_with_meta(tmp_path,
+                                                    fresh_programs):
+    from paddle_tpu.fluid.checkpoint import latest_checkpoint
+
+    sgd = _build_sgd()
+    faults.enable(seed=0)
+    faults.inject("supervisor/step", "preempt", after=2, times=1)
+    sup = TrainingSupervisor(str(tmp_path / "ck"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=10 ** 6,
+                             on_preempt="raise")
+    with pytest.raises(Preempted):
+        sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+                _reader_fn(_batches()), num_epochs=2)
+    snap = latest_checkpoint(str(tmp_path / "ck"))
+    meta = json.load(open(os.path.join(snap, SUPERVISOR_META)))
+    assert meta["kind"] == "urgent"
+    assert meta["step"] == 3  # preempt observed after the 3rd step
+    # the urgent checkpoint is resumable: a NEW supervisor (fresh
+    # process in production) picks up where the preempted one left off
+    sup2 = TrainingSupervisor(str(tmp_path / "ck"),
+                              program=sgd._main_program,
+                              steps_per_checkpoint=10 ** 6)
+    out = sup2.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+                   _reader_fn(_batches()), num_epochs=2)
+    assert out["steps"] == 12
+
+
+@pytest.mark.slow
+def test_nonfinite_rolls_back_to_last_good(tmp_path, fresh_programs):
+    """(slow: clean + chaos double run — the preempted-resume test
+    above already covers the trajectory machinery in tier-1; this one
+    runs in the ci.sh full suite.)"""
+    clean_losses, clean_params = _clean_run(tmp_path, fresh_programs)
+
+    _reset_workspace()
+    sgd = _build_sgd()
+    faults.enable(seed=0)
+    faults.inject("supervisor/step", "nonfinite", after=4, times=1)
+    losses = {}
+    sup = TrainingSupervisor(str(tmp_path / "nf"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=1)
+    out = sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+                  _reader_fn(_batches()), num_epochs=2,
+                  on_step=lambda s, l: losses.__setitem__(s, l))
+    assert out["restarts"] == 1
+    from paddle_tpu.obs import telemetry as obs_tele
+
+    snap = obs_tele.snapshot()
+    assert snap.get("supervisor_nonfinite_total") == 1
+    assert snap.get("supervisor_restarts_total{reason=nonfinite}") == 1
+    for step in clean_losses:
+        assert losses[step] == pytest.approx(clean_losses[step],
+                                             abs=1e-12)
+    params = _params_of(sgd)
+    for got, want in zip([params[k] for k in sorted(params)],
+                         clean_params):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_nonfinite_backs_off_loss_scale(tmp_path, fresh_programs):
+    from paddle_tpu.fluid.amp import LossScaler
+
+    sgd = _build_sgd()
+    scaler = LossScaler(init_scale=1024.0)
+    faults.enable(seed=0)
+    faults.inject("supervisor/step", "nonfinite", after=2, times=1)
+    sup = TrainingSupervisor(str(tmp_path / "ls"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=1,
+                             loss_scaler=scaler)
+    sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+            _reader_fn(_batches()), num_epochs=1)
+    assert scaler.scale == 512.0  # backed off once, after the restore
+
+
+def test_transient_reader_fault_restarts_and_completes(
+        tmp_path, fresh_programs):
+    sgd = _build_sgd()
+    faults.enable(seed=0)
+    faults.inject("reader/pump", "io_error", after=4, times=1)
+    sup = TrainingSupervisor(str(tmp_path / "rf"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=1)
+    out = sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+                  host_prefetch(_reader_fn(_batches()), depth=2),
+                  num_epochs=2)
+    assert out == {"steps": 12, "epochs": 2, "restarts": 1}
+
+
+def test_restart_budget_exceeded_raises(tmp_path, fresh_programs):
+    sgd = _build_sgd()
+    faults.enable(seed=0)
+    faults.inject("supervisor/step", "nonfinite", times=None)  # forever
+    sup = TrainingSupervisor(str(tmp_path / "rb"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=1, max_restarts=2)
+    with pytest.raises(RestartBudgetExceeded):
+        sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+                _reader_fn(_batches()), num_epochs=1)
+    from paddle_tpu.obs import telemetry as obs_tele
+
+    snap = obs_tele.snapshot()
+    assert snap.get("supervisor_restarts_total{reason=nonfinite}") == 3
+
+
+def test_nonretryable_step_error_propagates(tmp_path, fresh_programs):
+    sgd = _build_sgd()
+    sup = TrainingSupervisor(str(tmp_path / "nr"),
+                             program=sgd._main_program)
+
+    def bad_step(data):
+        raise ValueError("a bug must not be retried away")
+
+    with pytest.raises(ValueError):
+        sup.run(bad_step, _reader_fn(_batches()), num_epochs=1)
+
+
+def test_signal_handlers_restored_after_run(tmp_path, fresh_programs):
+    before = (signal.getsignal(signal.SIGTERM),
+              signal.getsignal(signal.SIGINT))
+    sgd = _build_sgd()
+    sup = TrainingSupervisor(str(tmp_path / "sh"),
+                             program=sgd._main_program,
+                             steps_per_checkpoint=10 ** 6)
+    sup.run(sgd.step_runner(feeding={"x": 0, "y": 1}),
+            _reader_fn(_batches(n=2)), num_epochs=1)
+    assert (signal.getsignal(signal.SIGTERM),
+            signal.getsignal(signal.SIGINT)) == before
+
+
+def test_step_runner_surfaces_numerics_monitor_signal(tmp_path,
+                                                      fresh_programs):
+    """With obs.health enabled, step_runner reports the monitor's
+    found-nonfinite verdict as a NaN loss — the supervisor's rollback
+    trigger — and the numerics counters move."""
+    import math
+
+    from paddle_tpu.obs import health as obs_health
+    from paddle_tpu.obs import telemetry as obs_tele
+
+    obs_health.enable()
+    sgd = _build_sgd()
+    step = sgd.step_runner(feeding={"x": 0, "y": 1})
+    bad = [(np.full(4, np.nan, np.float32),
+            np.zeros(1, np.float32)) for _ in range(4)]
+    assert math.isnan(step(bad))
+    snap = obs_tele.snapshot()
+    assert any(k.startswith("numerics_nonfinite_total{") and v > 0
+               for k, v in snap.items()), snap
+
+
+@pytest.mark.slow
+def test_parallel_trainer_supervised_resume(tmp_path, fresh_programs):
+    """The mesh-parallel trainer round-trips its sharded state through
+    supervisor checkpoints: preempt, resume, same final state as an
+    uninterrupted run.  (slow: two mesh-step compiles; runs in the
+    ci.sh full suite.)"""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.trainer import ParallelTrainer
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        mesh = make_mesh(n_devices=8, dp=8)
+        return ParallelTrainer(main, startup, ["x", "y"], [loss.name],
+                               mesh, seed=0).init()
+
+    rs = np.random.RandomState(0)
+    data = [{"x": rs.rand(8, 4).astype("f"),
+             "y": rs.rand(8, 1).astype("f")} for _ in range(4)]
+
+    def reader():
+        for b in data:
+            yield b
+
+    # clean reference
+    t_clean = build()
+    sup = TrainingSupervisor.for_parallel(t_clean,
+                                          str(tmp_path / "pc"),
+                                          steps_per_checkpoint=1)
+    sup.run_parallel(t_clean, reader, num_epochs=2)
+    want = {n: t_clean.fetch_state(n) for n in t_clean.state}
+
+    # preempted + resumed
+    t_chaos = build()
+    faults.enable(seed=0)
+    faults.inject("supervisor/step", "preempt", after=3, times=1)
+    sup2 = TrainingSupervisor.for_parallel(t_chaos,
+                                           str(tmp_path / "pp"),
+                                           steps_per_checkpoint=1)
+    out = sup2.run_parallel(t_chaos, reader, num_epochs=2)
+    assert out["restarts"] == 1
+    for name in want:
+        np.testing.assert_allclose(t_chaos.fetch_state(name),
+                                   want[name], rtol=1e-6, atol=1e-7)
