@@ -15,8 +15,9 @@ from .reader.decorator import batch
 __version__ = "0.1.0"
 
 __all__ = ["reader", "dataset", "batch", "fluid", "v2", "infer",
-           "layer", "image", "obs", "resilience"]
+           "layer", "image", "obs", "resilience", "analysis"]
 
+from . import analysis  # noqa: E402
 from . import obs  # noqa: E402
 from . import resilience  # noqa: E402
 from . import fluid  # noqa: E402

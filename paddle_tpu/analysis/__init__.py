@@ -1,0 +1,63 @@
+"""paddle_tpu.analysis — static analysis over the Program IR.
+
+Three layers (docs/ANALYSIS.md documents every diagnostic code):
+
+  * `verifier`  — structural well-formedness: registry membership,
+    def-before-use per block, BlockRef scoping, attr serializability,
+    and dtype/shape consistency re-derived through the registry's
+    infer-shape (V0xx codes).
+  * `dataflow`  — def-use chains and liveness (the ONE implementation;
+    the memory-optimization transpiler consumes it too), dead-op/
+    dead-var detection and the write-write / in-place-alias hazard
+    detector (D0xx/H0xx codes).
+  * `lints`     — TPU-specific rules: dynamic dims into MXU ops,
+    jit-segment splits, unseeded RNG, AMP dtype mixes, grad orphans
+    (L0xx codes).
+
+`check_program` runs all three and publishes finding counters into the
+obs registry; the sibling roofline COST analyzer lives in
+`fluid/analysis.py` (where the time goes vs. whether the program is
+even well-formed).
+
+Verification is wired in at the trust boundaries: the executor's
+FLAGS_verify_program gate (verify before first compile),
+`fluid.io.load_inference_model` (structural check on load), serving
+engine warmup, and the `proglint` CLI (`tools/lint_cli.py`).
+"""
+
+from .diagnostics import (Diagnostic, ProgramVerificationError, Report,
+                          Severity)
+from .dataflow import Liveness, analyze_dataflow
+from .lints import lint_program
+from .verifier import verify_program
+
+__all__ = [
+    "Diagnostic", "Severity", "Report", "ProgramVerificationError",
+    "Liveness", "verify_program", "analyze_dataflow", "lint_program",
+    "check_program",
+]
+
+
+def check_program(program, level="full", fetches=None, bucket_hints=None,
+                  suppress=(), publish=True, origin="analysis"):
+    """Run verifier + dataflow + lints over `program` (a Program or
+    ProgramDesc); returns one merged `Report`.
+
+    level: "structural" skips the infer-shape re-derivation (V005-007)
+        — cheap enough for every program load.
+    fetches: runtime fetch names; enables dead-op detection (fetch is
+        a by-name scope lookup, invisible to the IR without this).
+    bucket_hints: serving shape-bucket config; demotes the dynamic
+        batch-dim MXU finding to a covered advisory.
+    suppress: diagnostic suppressions ("H002", "H002@scale",
+        "H002@var:name") — see docs/ANALYSIS.md.
+    publish: count findings into the obs registry
+        (`analysis_diagnostics_total{code,severity}`).
+    """
+    report = Report(suppress=suppress)
+    verify_program(program, level=level, report=report)
+    analyze_dataflow(program, fetches=fetches, report=report)
+    lint_program(program, bucket_hints=bucket_hints, report=report)
+    if publish:
+        report.publish(origin=origin)
+    return report

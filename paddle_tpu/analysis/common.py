@@ -1,0 +1,36 @@
+"""Shared helpers for the analysis passes: the one scope-chain VarDesc
+walk, the one grad-op-to-forward OpInfo resolution, and the backward
+builder's missing-slot placeholder — so verifier, dataflow and lints
+can never disagree about name or op resolution."""
+
+from ..ops import registry as op_registry
+
+__all__ = ["EMPTY", "find_var_desc", "resolve_op_info"]
+
+EMPTY = "@EMPTY@"
+
+
+def resolve_op_info(op_type):
+    """The OpInfo governing `op_type`, resolving `<fwd>_grad` ops to
+    their forward's info (grad kernels inherit jittable/uses_rng from
+    the forward registration); None when the type is unknown — the
+    verifier's V001."""
+    if op_registry.has_op(op_type):
+        return op_registry.get_op_info(op_type)
+    if op_registry.is_grad_op_type(op_type):
+        fwd = op_registry.forward_type_of_grad(op_type)
+        if op_registry.has_op(fwd):
+            return op_registry.get_op_info(fwd)
+    return None
+
+
+def find_var_desc(desc, block_idx, name):
+    """VarDesc for `name` resolved through the block parent chain, or
+    None (mirrors framework._find_var_desc, over bare descs)."""
+    bd = desc.block(block_idx)
+    while True:
+        if name in bd.vars:
+            return bd.vars[name]
+        if bd.parent_idx < 0:
+            return None
+        bd = desc.block(bd.parent_idx)

@@ -218,6 +218,15 @@ class DistributeTranspiler:
                     "blocks": [(ep, int(b), int(s))
                                for ep, b, s in assign[p.name]],
                 }, infer_shape=False)
+
+        # the rewritten program ships to a whole cluster: verify its
+        # structure NOW (cheap desc walk, docs/ANALYSIS.md) so a
+        # transpiler bug fails at transpile time with op/var identity,
+        # not as an opaque error on some remote trainer
+        from .. import analysis
+
+        analysis.verify_program(program, level="structural") \
+            .publish(origin="transpiler").raise_on_error()
         return self
 
     # -- runtime helpers ----------------------------------------------------

@@ -14,6 +14,10 @@ the two is the actionable headroom.  The reference has no counterpart
 compute/bandwidth split is the whole performance story, so the
 analyzer is a first-class framework facility.
 
+This module is the COST half of program analysis.  The CORRECTNESS
+half — IR verification, alias/race detection, TPU lints over the same
+ProgramDescs — is `paddle_tpu.analysis` (docs/ANALYSIS.md).
+
 Model caveats (documented, deliberate):
   * bytes are per-op (every input read + output written once).  XLA
     fuses elementwise chains, so the true traffic sits between the

@@ -15,6 +15,13 @@ semantics.  An eager per-op mode (`run(..., eager=True)`) reproduces the
 reference's interpreter for debugging, per-op profiling and nan checks
 (reference: executor.cc:29 FLAGS_check_nan_inf).
 
+FLAGS_verify_program gates a verify-before-first-compile step: the
+`paddle_tpu.analysis` subsystem checks structure, re-derived
+shape/dtype metas and write/alias hazards once per program version,
+raising a `ProgramVerificationError` that names the offending op index
+and variable instead of letting a malformed desc surface as an opaque
+XLA trace error (docs/ANALYSIS.md).
+
 FLAGS_check_nan_inf scans ONLY the eager path — a jitted segment never
 sees the flag.  For compiled programs use `paddle_tpu.obs.health`:
 `NumericsMonitor` keeps on-device nonfinite/grad-norm counters inside
@@ -580,6 +587,9 @@ class Executor:
         from collections import OrderedDict
 
         self._cache = OrderedDict()
+        # (program token, version) pairs that passed verification
+        # under FLAGS_verify_program (see _verify_program)
+        self._verified = set()
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
@@ -630,6 +640,12 @@ class Executor:
                    flags.get_flag("bn_shifted_stats"))
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
+                # verify-before-first-compile (FLAGS_verify_program):
+                # a malformed program fails HERE with a Diagnostic-
+                # derived error naming op index + var, not three
+                # layers down as an XLA trace error
+                if flags.get_flag("verify_program"):
+                    self._verify_program(program, fetch_names)
                 compiled = _CompiledProgram(self, program, 0,
                                             sorted(feed_env.keys()),
                                             fetch_names)
@@ -645,6 +661,23 @@ class Executor:
             if return_numpy:
                 results = [self._to_numpy(r) for r in results]
             return results
+
+    def _verify_program(self, program, fetch_names):
+        """FLAGS_verify_program path: full analysis once per (program
+        identity, version) — edits bump the version, re-verifying; a
+        clean verdict is cached so steady-state runs pay one set
+        lookup."""
+        vkey = (program._cache_token, program.version)
+        if vkey in self._verified:
+            return
+        from .. import analysis
+
+        analysis.check_program(
+            program, level="full", fetches=list(fetch_names),
+            origin="executor").raise_on_error()
+        self._verified.add(vkey)
+        if len(self._verified) > 4 * self._CACHE_MAX:
+            self._verified.clear()  # rare: unbounded program churn
 
     def _prepare_feed(self, block_desc, name, val):
         if isinstance(val, (RaggedTensor, SelectedRows)):

@@ -23,7 +23,7 @@ __all__ = [
     "Variable", "Parameter", "Operator", "Block", "Program",
     "default_main_program", "default_startup_program", "program_guard",
     "switch_main_program", "switch_startup_program", "unique_name",
-    "grad_var_name",
+    "grad_var_name", "InferShapeError",
 ]
 
 
@@ -431,8 +431,51 @@ class Program:
         return p
 
 
+class InferShapeError(ValueError):
+    """Shape inference failed for one op.  Carries the op's identity —
+    type, block-wide op index, and the offending variable when known —
+    mirroring the structured fields `executor.NonfiniteError` provides
+    for runtime errors, so a failed append_op names WHERE instead of
+    surfacing a bare KeyError/TypeError from three layers down."""
+
+    def __init__(self, message, op_type=None, op_index=None,
+                 block_idx=None, var_name=None):
+        super().__init__(message)
+        self.op_type = op_type
+        self.op_index = op_index
+        self.block_idx = block_idx
+        self.var_name = var_name
+
+
 def infer_shape_for_op(block, op_desc):
-    """Set output VarDescs' shape/dtype/lod via the registry."""
+    """Set output VarDescs' shape/dtype/lod via the registry.
+
+    Failures raise `InferShapeError` naming the op type, its index in
+    the block, and the offending variable (NotImplementedError passes
+    through untouched — append_op treats it as "no rule")."""
+    try:
+        _infer_shape_for_op(block, op_desc)
+    except (NotImplementedError, InferShapeError):
+        raise
+    except Exception as err:
+        try:
+            op_index = block.desc.ops.index(op_desc)
+        except ValueError:
+            op_index = None
+        var_name = getattr(err, "_infer_shape_var", None)
+        where = "op %r" % op_desc.type
+        if op_index is not None:
+            where += " (op %d in block %d)" % (op_index, block.idx)
+        if var_name is not None:
+            where += ", var %r" % var_name
+        raise InferShapeError(
+            "shape inference failed for %s: %s: %s"
+            % (where, type(err).__name__, err),
+            op_type=op_desc.type, op_index=op_index,
+            block_idx=block.idx, var_name=var_name) from err
+
+
+def _infer_shape_for_op(block, op_desc):
     info = op_registry.get_op_info(op_desc.type)
     if info.infer_shape is not None:
         info.infer_shape(block, op_desc)
@@ -448,7 +491,7 @@ def infer_shape_for_op(block, op_desc):
     for slot, names in op_desc.inputs.items():
         metas = []
         for n in names:
-            vd = _find_var_desc(block, n)
+            vd = _find_var_desc_for(block, n)
             metas.append((vd.shape, vd.dtype, vd.lod_level, vd.type))
         ins_meta[slot] = metas
     outs = op_registry.generic_infer_shape(op_desc.type, ins_meta,
@@ -459,12 +502,22 @@ def infer_shape_for_op(block, op_desc):
             continue
         for n, meta in zip(names, metas):
             (shape, dtype, lod), rest = meta[:3], meta[3:]
-            vd = _find_var_desc(block, n)
+            vd = _find_var_desc_for(block, n)
             vd.shape = shape
             vd.dtype = canonical_dtype(dtype)
             vd.lod_level = lod
             if rest:
                 vd.type = rest[0]
+
+
+def _find_var_desc_for(block, name):
+    """_find_var_desc, stamping the missing name onto the KeyError so
+    `infer_shape_for_op` can report WHICH variable broke inference."""
+    try:
+        return _find_var_desc(block, name)
+    except KeyError as err:
+        err._infer_shape_var = name
+        raise
 
 
 def _grad_op_infer_shape(block, op_desc):

@@ -211,6 +211,25 @@ def prune_program(program, targets):
         raise ValueError(
             "inference target %r is produced by no op (feed "
             "variables cannot be targets)" % name)
+
+    # drop root VarDescs nothing in the pruned graph references:
+    # without this every @GRAD/@RENAME temp of the training tail ships
+    # as declaration debris in the export (the analyzer's L005/D002
+    # findings — found by dogfooding proglint on our own exports).
+    # Persistables stay (load_inference_model loads by predicate), as
+    # does anything a sub-block touches by name.
+    referenced = set(target_names)
+    for b in desc.blocks:
+        for op in b.ops:
+            referenced.update(op.input_names())
+            referenced.update(op.output_names())
+        if b.idx != 0:
+            referenced.update(b.vars.keys())
+    for name in list(block.vars):
+        if name in referenced or block.vars[name].persistable:
+            continue
+        del block.vars[name]
+        pruned.blocks[0].vars.pop(name, None)
     return pruned
 
 
@@ -280,6 +299,15 @@ def load_inference_model(dirname, executor, model_filename="__model__",
                       for i, bd in enumerate(program.desc.blocks)]
     for b in program.blocks:
         b.sync_with_desc()
+    # a loaded program was not built by this process: verify its
+    # structure before anything compiles it (cheap desc walk — no
+    # infer-shape re-derivation; the serving engine's warmup runs the
+    # full check).  Error findings raise ProgramVerificationError
+    # naming op index + var.
+    from .. import analysis
+
+    analysis.verify_program(program, level="structural") \
+        .publish(origin="io_load").raise_on_error()
     # load persistables recorded in the program
     vars = [v for v in program.list_vars() if v.persistable]
     load_vars(executor, dirname, vars=vars)

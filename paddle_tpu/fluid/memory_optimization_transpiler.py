@@ -10,64 +10,67 @@ at compile time — but in the eager debug executor it genuinely caps the
 live-buffer count, and the rewrite doubles as the reference-parity
 surface.  `memory_optimize(..., rewrite=False)` keeps the old
 report-only behavior.
+
+Liveness itself is computed by `paddle_tpu.analysis.dataflow.Liveness`
+— the same engine behind the analyzer's dead-code and hazard
+diagnostics — so reuse decisions and diagnostics share one definition
+of variable lifetime.
 """
 
 from collections import defaultdict
 
+from ..analysis.dataflow import Liveness
 from . import framework
 
 __all__ = ["memory_optimize", "ControlFlowGraph"]
 
 
 class ControlFlowGraph:
-    """Forward liveness over a block's op list (reference:
-    memory_optimization_transpiler.py ControlFlowGraph:33 — same uses /
-    defs / live-in / live-out construction)."""
+    """Liveness view over the root block (reference:
+    memory_optimization_transpiler.py ControlFlowGraph:33).  The
+    uses/defs/live-in/live-out computation itself lives in
+    `paddle_tpu.analysis.dataflow.Liveness` — ONE implementation shared
+    with the dead-code/hazard diagnostics, so the reuse pass and the
+    analyzer can never disagree about when a variable dies; this class
+    keeps the transpiler-facing surface (program binding, persistable
+    filtering) and the historical attribute names."""
 
     def __init__(self, program):
         self._program = program
         block = program.global_block()
-        self._ops = list(block.desc.ops)
-        # "@EMPTY@" is the backward builder's missing-slot placeholder,
-        # not a variable (same filter as the executor's analysis)
-        self._uses = [set(od.input_names()) - {"@EMPTY@"}
-                      for od in self._ops]
-        self._defs = [set(od.output_names()) - {"@EMPTY@"}
-                      for od in self._ops]
-        self._live_in = [set() for _ in self._ops]
-        self._live_out = [set() for _ in self._ops]
+        # "@EMPTY@" filtering happens inside Liveness (the backward
+        # builder's missing-slot placeholder is not a variable)
+        self._lv = Liveness(block.desc.ops)
+        self._ops = self._lv.ops
+
+    # historical attribute surface (the rewrite loop reads these)
+    @property
+    def _uses(self):
+        return self._lv.uses
+
+    @property
+    def _defs(self):
+        return self._lv.defs
+
+    @property
+    def _live_in(self):
+        return self._lv.live_in
+
+    @property
+    def _live_out(self):
+        return self._lv.live_out
 
     def analyze(self):
-        changed = True
-        n = len(self._ops)
-        while changed:
-            changed = False
-            for i in reversed(range(n)):
-                live_out = set()
-                if i + 1 < n:
-                    live_out = self._live_in[i + 1]
-                live_in = self._uses[i] | (live_out - self._defs[i])
-                if live_in != self._live_in[i] or \
-                        live_out != self._live_out[i]:
-                    self._live_in[i] = live_in
-                    self._live_out[i] = live_out
-                    changed = True
+        self._lv.analyze()
         return self
 
     def reuse_candidates(self):
         """Vars dead after an op whose buffer a later def could reuse
         (what XLA's buffer assignment will actually fold)."""
-        persist = set()
         block = self._program.global_block()
-        for name, var in block.vars.items():
-            if getattr(var, "persistable", False):
-                persist.add(name)
-        released = defaultdict(list)
-        for i in range(len(self._ops)):
-            dead = (self._live_in[i] | self._defs[i]) - self._live_out[i]
-            for name in sorted(dead - persist):
-                released[i].append(name)
-        return dict(released)
+        persist = {name for name, var in block.vars.items()
+                   if getattr(var, "persistable", False)}
+        return self._lv.reuse_candidates(persistable=persist)
 
 
 def _sub_block_names(program):

@@ -7,6 +7,12 @@ proximal_gd_op.cc, proximal_adagrad_op.cc).  Updates are pure functions;
 the executor donates parameter buffers so XLA updates them in place.
 Sparse (SelectedRows) gradients follow the reference's row-wise update
 semantics (e.g. sgd_op.cc SelectedRows path) via scatter-add.
+
+Every update op declares `in_place_outputs` (ParamOut aliases Param,
+each state output aliases its state input) so the static analyzer's
+alias/race detector (`paddle_tpu.analysis.dataflow`) can validate that
+the aliased slots really name the same variable and that no concurrent
+reader races the in-place write.
 """
 
 import numpy as np
@@ -36,7 +42,8 @@ def _apply_update(param, delta_fn, grad):
     return delta_fn(param, grad)
 
 
-@register_op("sgd", stop_gradient_op=True)
+@register_op("sgd", stop_gradient_op=True,
+             in_place_outputs=("ParamOut",))
 def sgd(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     if isinstance(g, SelectedRows):
@@ -47,7 +54,8 @@ def sgd(ctx, ins, attrs):
     return {"ParamOut": [out]}
 
 
-@register_op("momentum", stop_gradient_op=True)
+@register_op("momentum", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "VelocityOut"))
 def momentum(ctx, ins, attrs):
     p, g, v, lr = (_p(ins, "Param"), _p(ins, "Grad"),
                    _p(ins, "Velocity"), _lr(ins))
@@ -62,7 +70,8 @@ def momentum(ctx, ins, attrs):
     return {"ParamOut": [p_out], "VelocityOut": [v_out]}
 
 
-@register_op("adam", stop_gradient_op=True)
+@register_op("adam", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "Moment1Out", "Moment2Out"))
 def adam(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
@@ -81,7 +90,8 @@ def adam(ctx, ins, attrs):
             "Moment2Out": [m2_out]}
 
 
-@register_op("adamax", stop_gradient_op=True)
+@register_op("adamax", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "MomentOut", "InfNormOut"))
 def adamax(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     m, inf = _p(ins, "Moment"), _p(ins, "InfNorm")
@@ -99,7 +109,8 @@ def adamax(ctx, ins, attrs):
             "InfNormOut": [inf_out]}
 
 
-@register_op("adagrad", stop_gradient_op=True)
+@register_op("adagrad", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "MomentOut"))
 def adagrad(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     mom = _p(ins, "Moment")
@@ -116,7 +127,8 @@ def adagrad(ctx, ins, attrs):
     return {"ParamOut": [p_out], "MomentOut": [mom_out]}
 
 
-@register_op("decayed_adagrad", stop_gradient_op=True)
+@register_op("decayed_adagrad", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "MomentOut"))
 def decayed_adagrad(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     mom = _p(ins, "Moment")
@@ -129,7 +141,9 @@ def decayed_adagrad(ctx, ins, attrs):
     return {"ParamOut": [p_out], "MomentOut": [mom_out]}
 
 
-@register_op("adadelta", stop_gradient_op=True)
+@register_op("adadelta", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "AvgSquaredGradOut",
+                               "AvgSquaredUpdateOut"))
 def adadelta(ctx, ins, attrs):
     p, g = _p(ins, "Param"), _p(ins, "Grad")
     avg_sq_g = _p(ins, "AvgSquaredGrad")
@@ -145,7 +159,9 @@ def adadelta(ctx, ins, attrs):
             "AvgSquaredUpdateOut": [asu_out]}
 
 
-@register_op("rmsprop", stop_gradient_op=True)
+@register_op("rmsprop", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "MomentOut",
+                               "MeanSquareOut"))
 def rmsprop(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
@@ -160,7 +176,9 @@ def rmsprop(ctx, ins, attrs):
             "MeanSquareOut": [ms_out]}
 
 
-@register_op("ftrl", stop_gradient_op=True)
+@register_op("ftrl", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "SquaredAccumOut",
+                               "LinearAccumOut"))
 def ftrl(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     sq_accum, lin_accum = _p(ins, "SquaredAccumulator"), \
@@ -188,7 +206,8 @@ def ftrl(ctx, ins, attrs):
             "LinearAccumOut": [lin_out]}
 
 
-@register_op("fused_update", stop_gradient_op=True)
+@register_op("fused_update", stop_gradient_op=True,
+             in_place_outputs=("ParamOut",))
 def fused_update(ctx, ins, attrs):
     """Stacked same-recipe update (fluid/fusion.py): concatenate the
     flattened per-parameter tensors of each stacked slot, run the inner
@@ -223,7 +242,8 @@ def fused_update(ctx, ins, attrs):
             for k, v in res.items()}
 
 
-@register_op("proximal_gd", stop_gradient_op=True)
+@register_op("proximal_gd", stop_gradient_op=True,
+             in_place_outputs=("ParamOut",))
 def proximal_gd(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     l1 = attrs.get("l1", 0.0)
@@ -236,7 +256,8 @@ def proximal_gd(ctx, ins, attrs):
     return {"ParamOut": [p_out]}
 
 
-@register_op("proximal_adagrad", stop_gradient_op=True)
+@register_op("proximal_adagrad", stop_gradient_op=True,
+             in_place_outputs=("ParamOut", "MomentOut"))
 def proximal_adagrad(ctx, ins, attrs):
     p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _lr(ins)
     mom = _p(ins, "Moment")

@@ -350,6 +350,23 @@ class InferenceEngine:
         feeds warm only each batch bucket's smallest token/seqlen
         shape — see the module docstring).  Returns the number of
         buckets warmed."""
+        # deploy-time static analysis FIRST — it must run even when
+        # bucketing (and thus warmup compiling) is disabled: the
+        # engine serves a program it did not build (a
+        # load_inference_model export), so check structure, re-derived
+        # metas, alias/race hazards and TPU lints before any request
+        # can hit an opaque XLA error.  Error findings abort the
+        # deploy here with op/var identity; warnings/lints land in the
+        # registry (analysis_diagnostics_total{code}) for /metrics.
+        from .. import analysis
+
+        hints = (None if self.config.batch_buckets is None
+                 else {"batch_buckets": list(self.config.batch_buckets)})
+        analysis.check_program(
+            self.program, level="full", fetches=list(self.fetch_names),
+            bucket_hints=hints, origin="serving_warmup") \
+            .raise_on_error()
+
         if self.config.batch_buckets is None:
             return 0
         has_ragged = any(m["lod_level"] > 0

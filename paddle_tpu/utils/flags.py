@@ -98,6 +98,17 @@ DEFINE_flag("xla_cost_attribution", False,
             "warmup and mega_bench's non-risky legs enable it, the "
             "surfaces whose /metrics and BENCH artifacts consume the "
             "attribution and can afford the startup cost")
+DEFINE_flag("verify_program", False,
+            "run paddle_tpu.analysis verification on every program "
+            "before its FIRST compile (per executor + program "
+            "version): structural + infer-shape re-derivation + "
+            "write/alias hazards.  Error-severity findings raise "
+            "ProgramVerificationError naming the op index and "
+            "variable instead of surfacing as an opaque XLA trace "
+            "error.  Default off: the full check re-derives every "
+            "op's output meta through jax.eval_shape, a build-time "
+            "cost that the surfaces opting into verification (tests, "
+            "serving warmup, the proglint CLI) pay explicitly")
 DEFINE_flag("amp_bf16_act", True,
             "when amp_bf16 is on, keep activations bfloat16 between ops "
             "instead of casting every MXU output back to f32 — halves "

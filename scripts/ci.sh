@@ -34,6 +34,12 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
+echo "[ci] proglint selftest (clean program verifies, 7 seeded corruptions each report their diagnostic code, executor verify gate) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --selftest
+
+echo "[ci] proglint golden fixtures (checked-in IR must be well-formed, not just pinned) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --golden --quiet
+
 echo "[ci] driver entry points ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
     python bench.py

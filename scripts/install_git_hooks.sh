@@ -16,6 +16,9 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export PYTHONPATH=
 python -m compileall -q paddle_tpu tests examples bench.py __graft_entry__.py
 make -C native -q || make -C native
+# the checked-in golden ProgramDescs must be well-formed IR, not just
+# byte-stable: proglint walks each fixture through the full verifier
+python -m paddle_tpu.tools.lint_cli --golden --quiet
 python -m pytest tests/test_math_ops.py tests/test_fit_a_line.py -q
 EOF
 chmod +x "$hook"

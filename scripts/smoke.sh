@@ -28,6 +28,9 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[smoke] chaos selftest (injected I/O fault + preemption + nonfinite; auto-resume must match fault-free run) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
+echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --selftest
+
 echo "[smoke] dryrun_multichip(8) ..."
 # Simulate the driver env exactly: JAX_PLATFORMS points at the real TPU
 # and the function itself must bootstrap the virtual CPU mesh.  timeout

@@ -1,0 +1,772 @@
+"""paddle_tpu.analysis: IR verifier, dataflow/hazard detection, TPU
+lints, and their wiring (executor FLAGS_verify_program gate, io load
+verification, serving warmup, memory-optimize delegation).
+
+Negative tests corrupt real programs deliberately and assert the
+STABLE diagnostic code (docs/ANALYSIS.md) — the contract the proglint
+CLI selftest and CI enforce too."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.core.desc import BlockRef, OpDesc, VarDesc
+from paddle_tpu.fluid import framework
+from paddle_tpu.utils import flags
+
+
+def _build_train(main=None, startup=None):
+    """fc -> mse -> SGD in a fresh Program pair."""
+    main = main or fluid.Program()
+    startup = startup or fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# verifier
+# ---------------------------------------------------------------------------
+
+def test_clean_program_verifies():
+    main, startup, loss = _build_train()
+    rep = analysis.check_program(main, fetches=[loss.name],
+                                 publish=False)
+    assert rep.ok(), rep.format()
+    assert not rep.warnings, rep.format()
+    srep = analysis.check_program(startup, publish=False)
+    assert srep.ok(), srep.format()
+
+
+def test_unknown_op_v001():
+    main, _, _ = _build_train()
+    main.desc.block(0).ops[1].type = "definitely_not_an_op"
+    rep = analysis.verify_program(main, level="structural")
+    assert rep.has("V001")
+    d = [x for x in rep.errors if x.code == "V001"][0]
+    assert d.op_index == 1 and d.op_type == "definitely_not_an_op"
+
+
+def test_undeclared_var_v002():
+    main, _, _ = _build_train()
+    main.desc.block(0).ops[0].inputs["X"] = ["never_declared"]
+    rep = analysis.verify_program(main, level="structural")
+    assert rep.has("V002")
+    assert any(d.var_name == "never_declared" for d in rep.errors)
+
+
+def test_use_before_def_v003():
+    main, _, loss = _build_train()
+    ops = main.desc.block(0).ops
+    idx = next(i for i, od in enumerate(ops)
+               if loss.name in od.output_names())
+    ops.insert(0, ops.pop(idx))  # hoist the mean above its producers
+    rep = analysis.verify_program(main, level="structural")
+    assert rep.has("V003"), rep.format()
+
+
+def test_dangling_block_ref_v004():
+    main, _, _ = _build_train()
+    main.desc.block(0).ops[0].attrs["sub_block"] = BlockRef(42)
+    rep = analysis.verify_program(main, level="structural")
+    assert rep.has("V004")
+
+
+def test_dtype_mismatch_v005():
+    main, _, _ = _build_train()
+    bd = main.desc.block(0)
+    out = next(od.output_names()[0] for od in bd.ops
+               if od.type == "mul")
+    bd.vars[out].dtype = "int32"  # re-derivation says float32
+    rep = analysis.verify_program(main, level="full")
+    assert rep.has("V005"), rep.format()
+    # structural level must NOT pay for (or catch) the re-derivation
+    assert not analysis.verify_program(main,
+                                       level="structural").has("V005")
+
+
+def test_shape_mismatch_v006():
+    main, _, _ = _build_train()
+    bd = main.desc.block(0)
+    out = next(od.output_names()[0] for od in bd.ops
+               if od.type == "mul")
+    bd.vars[out].shape = (-1, 7)  # fc emits (-1, 1)
+    rep = analysis.verify_program(main, level="full")
+    assert rep.has("V006"), rep.format()
+
+
+def test_infer_shape_failure_v007():
+    main, _, _ = _build_train()
+    bd = main.desc.block(0)
+    # break the matmul algebra itself: x becomes (-1, 5) against a
+    # (13, 1) weight
+    bd.vars["x"].shape = (-1, 5)
+    rep = analysis.verify_program(main, level="full")
+    assert rep.has("V007") or rep.has("V006"), rep.format()
+
+
+def test_bad_attr_v008():
+    main, _, _ = _build_train()
+    main.desc.block(0).ops[0].attrs["hook"] = object()
+    rep = analysis.verify_program(main, level="structural")
+    assert rep.has("V008")
+
+
+def test_inplace_first_writer_is_not_use_before_def():
+    """increment(x, in_place=True) on a fed var makes the op both the
+    first writer AND a reader of x — the by-name in-place idiom, legal
+    when fed/scope-resident, must not be a V003."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              append_batch_size=False)
+        from paddle_tpu.fluid.layers import tensor as tensor_layers
+
+        tensor_layers.increment(x, value=1.0, in_place=True)
+    rep = analysis.verify_program(main, level="structural")
+    assert not rep.has("V003"), rep.format()
+
+
+def test_lint_rng_seed_unknowable_on_bare_desc():
+    """random_seed is Program state, not desc state: a round-tripped
+    ProgramDesc must not produce L003 (the seed is unknowable, and a
+    seeded program would be falsely flagged under --strict)."""
+    from paddle_tpu.core.desc import ProgramDesc
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.dropout(x=x, dropout_prob=0.5)
+    main.random_seed = 1234
+    assert not analysis.lint_program(main).has("L003")
+    bare = ProgramDesc.from_dict(main.desc.to_dict())
+    assert not analysis.lint_program(bare).has("L003")
+
+
+# ---------------------------------------------------------------------------
+# dataflow: dead code + hazards
+# ---------------------------------------------------------------------------
+
+def test_dead_op_d001_requires_fetches():
+    main, _, loss = _build_train()
+    bd = main.desc.block(0)
+    bd.vars["__unused__"] = VarDesc("__unused__", dtype="float32",
+                                    shape=(1,))
+    bd.ops.append(OpDesc("scale", {"X": [loss.name]},
+                         {"Out": ["__unused__"]}, {"scale": 1.0}))
+    with_fetch = analysis.analyze_dataflow(main, fetches=[loss.name])
+    assert with_fetch.has("D001")
+    # fetch is a runtime by-name lookup: without the fetch set every
+    # sink is presumed fetched, so no dead-op findings at all
+    without = analysis.analyze_dataflow(main)
+    assert not without.has("D001")
+
+
+def test_dead_var_d002():
+    main, _, _ = _build_train()
+    main.desc.block(0).vars["__orphan__"] = VarDesc(
+        "__orphan__", dtype="float32", shape=(4,))
+    rep = analysis.analyze_dataflow(main)
+    assert any(d.code == "D002" and d.var_name == "__orphan__"
+               for d in rep.diagnostics)
+
+
+def test_write_write_race_h001():
+    main, _, _ = _build_train()
+    bd = main.desc.block(0)
+    i = next(i for i, od in enumerate(bd.ops) if od.type == "mul")
+    od = bd.ops[i]
+    bd.ops.insert(i + 1, OpDesc(od.type, dict(od.inputs),
+                                dict(od.outputs), dict(od.attrs)))
+    rep = analysis.analyze_dataflow(main)
+    assert rep.has("H001")
+    assert [d for d in rep.errors if d.code == "H001"], \
+        "H001 must be error severity"
+
+
+def test_inplace_alias_read_hazard_h002():
+    main, _, _ = _build_train()
+    bd = main.desc.block(0)
+    param = next(n for n, vd in bd.vars.items() if vd.is_parameter)
+    bd.vars["__shadow__"] = VarDesc("__shadow__", dtype="float32",
+                                    shape=(13, 1))
+    # an unordered reader of the in-place-updated parameter: nothing
+    # orders it against the sgd write except list position
+    bd.ops.insert(0, OpDesc("scale", {"X": [param]},
+                            {"Out": ["__shadow__"]}, {"scale": 2.0}))
+    rep = analysis.analyze_dataflow(main)
+    assert rep.has("H002"), rep.format()
+    # the clean program has NO such hazard (every Param reader feeds
+    # the grad chain the sgd op consumes)
+    clean, _, _ = _build_train()
+    assert not analysis.analyze_dataflow(clean).has("H002")
+
+
+def test_overwrite_read_race_h002_non_inplace():
+    """write v -> read v -> rewrite v: the reader has no dataflow path
+    to the rewrite, so a data-edge-only schedule can hand it the
+    second value — the read-write half of the hazard detector, for
+    writers that are NOT in-place."""
+    main = fluid.Program()
+    bd = main.desc.block(0)
+    for n in ("c1", "c2", "v", "out", "out2"):
+        bd.vars[n] = VarDesc(n, dtype="float32", shape=(4,))
+    bd.ops.append(OpDesc("scale", {"X": ["c1"]}, {"Out": ["v"]},
+                         {"scale": 1.0}))
+    bd.ops.append(OpDesc("scale", {"X": ["v"]}, {"Out": ["out"]},
+                         {"scale": 1.0}))
+    bd.ops.append(OpDesc("scale", {"X": ["c2"]}, {"Out": ["v"]},
+                         {"scale": 1.0}))
+    bd.ops.append(OpDesc("scale", {"X": ["v"]}, {"Out": ["out2"]},
+                         {"scale": 1.0}))
+    rep = analysis.analyze_dataflow(main, fetches=["out", "out2"])
+    assert any(d.code == "H002" and d.var_name == "v" and
+               d.op_index == 2 for d in rep.diagnostics), rep.format()
+    # no false H001: the read between the writes rules out lost-update
+    assert not rep.has("H001"), rep.format()
+
+
+def test_inplace_not_aliased_h003():
+    main, _, _ = _build_train()
+    bd = main.desc.block(0)
+    sgd = next(od for od in bd.ops if od.type == "sgd")
+    bd.vars["__forked__"] = VarDesc(
+        "__forked__", dtype="float32",
+        shape=bd.vars[sgd.input("Param")[0]].shape)
+    sgd.outputs["ParamOut"] = ["__forked__"]  # update forks the state
+    rep = analysis.analyze_dataflow(main)
+    assert rep.has("H003"), rep.format()
+
+
+def test_inplace_abbreviated_slot_h003():
+    """ftrl's SquaredAccumOut aliases the SquaredAccumulator input —
+    the abbreviated-slot convention must still map, so forking the
+    accumulator state is caught."""
+    main = fluid.Program()
+    bd = main.desc.block(0)
+    for n, shape in (("p", (4,)), ("g", (4,)), ("lr", (1,)),
+                     ("sq", (4,)), ("lin", (4,)), ("sq_fork", (4,))):
+        bd.vars[n] = VarDesc(n, dtype="float32", shape=shape,
+                             persistable=(n != "g"))
+    bd.ops.append(OpDesc(
+        "ftrl",
+        {"Param": ["p"], "Grad": ["g"], "LearningRate": ["lr"],
+         "SquaredAccumulator": ["sq"], "LinearAccumulator": ["lin"]},
+        {"ParamOut": ["p"], "SquaredAccumOut": ["sq_fork"],
+         "LinearAccumOut": ["lin"]}, {}))
+    rep = analysis.analyze_dataflow(main)
+    assert any(d.code == "H003" and d.var_name == "sq_fork"
+               for d in rep.diagnostics), rep.format()
+
+
+def test_adam_beta_pow_known_hazard_and_suppression():
+    """The Adam shared-scalar advance (scale beta_pow -> beta_pow after
+    the update ops) is a KNOWN H002: only list order separates the
+    adam reads from the in-place advance.  Safe on the current
+    executor (ops lower in list order), documented in
+    docs/ANALYSIS.md, and the suppression syntax handles it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        loss = fluid.layers.mean(x=fluid.layers.fc(input=x, size=3))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rep = analysis.check_program(main, publish=False)
+    assert rep.has("H002") and rep.ok(), rep.format()
+    sup = analysis.check_program(main, publish=False,
+                                 suppress=("H002@scale",))
+    assert not sup.has("H002") and sup.suppressed
+
+
+# ---------------------------------------------------------------------------
+# lints
+# ---------------------------------------------------------------------------
+
+def test_lint_dynamic_dim_l001_bucket_hints():
+    main, _, _ = _build_train()
+    plain = analysis.lint_program(main)
+    hinted = analysis.lint_program(main,
+                                   bucket_hints={"batch_buckets": [8]})
+    finds = [d for d in plain.diagnostics if d.code == "L001"]
+    assert finds and all(d.severity == "info" for d in finds)
+    assert any("without shape buckets" in d.message for d in finds)
+    assert all("bucketing covers it" in d.message
+               for d in hinted.diagnostics if d.code == "L001")
+
+
+def test_lint_rng_seed_l003():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.dropout(x=x, dropout_prob=0.5)
+    rep = analysis.lint_program(main)
+    assert any(d.code == "L003" and d.op_type == "dropout"
+               for d in rep.diagnostics)
+    # seed plumbing silences it: program-level ...
+    main.random_seed = 7
+    assert not analysis.lint_program(main).has("L003")
+    # ... or op-level
+    main.random_seed = 0
+    next(od for od in main.desc.block(0).ops
+         if od.type == "dropout").attrs["fix_seed"] = True
+    assert not analysis.lint_program(main).has("L003")
+    # initializer idiom exempt: startup RNG writes persistable params
+    _, startup2, _ = _build_train()
+    assert not analysis.lint_program(startup2).has("L003")
+
+
+def test_lint_amp_mix_l004():
+    main = fluid.Program()
+    bd = main.desc.block(0)
+    bd.vars["a"] = VarDesc("a", dtype="bfloat16", shape=(4, 4))
+    bd.vars["b"] = VarDesc("b", dtype="float32", shape=(4, 4))
+    bd.vars["c"] = VarDesc("c", dtype="float32", shape=(4, 4))
+    bd.ops.append(OpDesc("elementwise_add", {"X": ["a"], "Y": ["b"]},
+                         {"Out": ["c"]}, {}))
+    rep = analysis.lint_program(main)
+    assert rep.has("L004")
+    # persistable bf16 master
+    main2 = fluid.Program()
+    main2.desc.block(0).vars["w"] = VarDesc(
+        "w", dtype="bfloat16", shape=(4,), persistable=True)
+    assert analysis.lint_program(main2).has("L004")
+
+
+def test_lint_grad_orphan_l005():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        loss = fluid.layers.mean(x=fluid.layers.fc(input=x, size=3))
+        fluid.append_backward(loss)  # grads computed, never consumed
+    rep = analysis.lint_program(main)
+    orphans = [d for d in rep.diagnostics if d.code == "L005"]
+    assert any("never applied" in d.message for d in orphans)
+    # minimize() consumes them: clean
+    clean, _, _ = _build_train()
+    assert not analysis.lint_program(clean).has("L005")
+    # declared-but-unreferenced grad debris
+    main2 = fluid.Program()
+    main2.desc.block(0).vars["v@GRAD"] = VarDesc(
+        "v@GRAD", dtype="float32", shape=(4,))
+    assert analysis.lint_program(main2).has("L005")
+
+
+def test_lint_segment_split_l002():
+    main = fluid.Program()
+    bd = main.desc.block(0)
+    for n in ("a", "b", "c"):
+        bd.vars[n] = VarDesc(n, dtype="float32", shape=(4,))
+    bd.ops.append(OpDesc("scale", {"X": ["a"]}, {"Out": ["b"]},
+                         {"scale": 1.0}))
+    bd.ops.append(OpDesc("print", {"X": ["b"]}, {"Out": ["b"]},
+                         {"message": "mid"}))
+    bd.ops.append(OpDesc("scale", {"X": ["b"]}, {"Out": ["c"]},
+                         {"scale": 1.0}))
+    rep = analysis.lint_program(main)
+    assert any(d.code == "L002" and d.op_type == "print"
+               for d in rep.diagnostics), rep.format()
+
+
+# ---------------------------------------------------------------------------
+# wiring: executor gate, io load, serving warmup
+# ---------------------------------------------------------------------------
+
+def test_executor_verify_gate_catches_before_compile():
+    main, startup, loss = _build_train()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.zeros((2, 13), np.float32),
+            "y": np.zeros((2, 1), np.float32)}
+    prev = flags.get_flag("verify_program")
+    flags.set_flag("verify_program", True)
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(out).all()
+            bad = main.clone()
+            bad.desc.block(0).ops[2].type = "definitely_not_an_op"
+            with pytest.raises(analysis.ProgramVerificationError) as ei:
+                exe.run(bad, feed=feed, fetch_list=[loss])
+            # the Diagnostic-derived error names op index + identity
+            assert "op 2" in str(ei.value)
+            first = ei.value.report.errors[0]
+            assert first.op_index == 2 and first.block_idx == 0
+    finally:
+        flags.set_flag("verify_program", prev)
+
+
+def test_io_load_verifies_program(tmp_path):
+    main, startup, loss = _build_train()
+    from paddle_tpu.fluid import io as fluid_io
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid_io.save_inference_model(str(tmp_path), ["x", "y"],
+                                      [loss], exe, main_program=main)
+        # clean export loads (and re-verifies) fine
+        prog, feeds, fetches = fluid_io.load_inference_model(
+            str(tmp_path), exe)
+        assert feeds == ["x", "y"]
+        # tamper with the serialized IR: unknown op type
+        path = os.path.join(str(tmp_path), "__model__")
+        with open(path) as f:
+            meta = json.load(f)
+        meta["program"]["blocks"][0]["ops"][0]["type"] = "nope_op"
+        with open(path, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(analysis.ProgramVerificationError):
+            fluid_io.load_inference_model(str(tmp_path), exe)
+
+
+def test_serving_warmup_verifies():
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.serving import EngineConfig, InferenceEngine
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+        probs = fluid.layers.fc(input=img, size=3, act="softmax")
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    pruned = fluid_io.prune_program(main, [probs])
+
+    engine = InferenceEngine(pruned, ["img"], [probs], scope=scope,
+                             config=EngineConfig(batch_buckets=[2]))
+    assert engine.warmup() == 1
+    snap = {s["name"] for s in
+            obs_registry.get_registry().to_dict()["metrics"]}
+    assert "analysis_runs_total" in snap
+
+    # corrupted program: warmup refuses before burning compiles
+    bad = pruned.clone()
+    bad.desc.block(0).ops[0].type = "definitely_not_an_op"
+    engine2 = InferenceEngine(bad, ["img"], [probs], scope=scope,
+                              config=EngineConfig(batch_buckets=[2]))
+    with pytest.raises(analysis.ProgramVerificationError):
+        engine2.warmup()
+    # the analysis must run even when bucketing (and thus warmup
+    # compiling) is disabled — exact-shape engines deploy the same
+    # untrusted exports
+    engine3 = InferenceEngine(bad, ["img"], [probs], scope=scope,
+                              config=EngineConfig(batch_buckets=None))
+    with pytest.raises(analysis.ProgramVerificationError):
+        engine3.warmup()
+
+
+# ---------------------------------------------------------------------------
+# backward / transpiler outputs verify clean (mandatory under test)
+# ---------------------------------------------------------------------------
+
+def test_backward_output_verifies_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="relu")
+        loss = fluid.layers.mean(x=fluid.layers.fc(input=h, size=1))
+        fluid.append_backward(loss)
+    rep = analysis.verify_program(main, level="full")
+    assert rep.ok(), rep.format()
+    assert not analysis.analyze_dataflow(main).errors
+
+
+def test_transpiler_output_verifies_clean():
+    from paddle_tpu.distributed.transpiler import DistributeTranspiler
+
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        optimize_ops, params_grads = fluid.optimizer.SGD(
+            learning_rate=0.01).minimize(loss)
+        t = DistributeTranspiler()
+        t.transpile(optimize_ops=optimize_ops,
+                    params_grads=params_grads, trainer_id=0,
+                    trainers=2, pservers="127.0.0.1:6174,127.0.0.1:6175")
+    rep = analysis.check_program(main, publish=False)
+    assert rep.ok(), rep.format()
+    assert not rep.warnings, rep.format()
+
+
+# ---------------------------------------------------------------------------
+# dogfood: every golden builder + model topologies verify error-free
+# ---------------------------------------------------------------------------
+
+GOLDEN_BUILDERS = ["fit_a_line", "conv_classifier", "dynamic_rnn",
+                   "deepfm"]
+
+
+@pytest.mark.parametrize("case", GOLDEN_BUILDERS)
+def test_dogfood_golden_builders(case):
+    import test_golden_programs as golden
+
+    builder = {
+        "fit_a_line": golden._build_fit_a_line,
+        "conv_classifier": golden._build_conv_classifier,
+        "dynamic_rnn": golden._build_dynamic_rnn,
+        "deepfm": golden._build_deepfm,
+    }[case]
+    builder()
+    main = fluid.default_main_program()
+    rep = analysis.check_program(main, publish=False)
+    assert rep.ok(), "%s main:\n%s" % (case, rep.format())
+    assert not rep.warnings, "%s main:\n%s" % (case, rep.format())
+    srep = analysis.check_program(fluid.default_startup_program(),
+                                  publish=False)
+    assert srep.ok(), "%s startup:\n%s" % (case, srep.format())
+    assert not srep.warnings, "%s startup:\n%s" % (case, srep.format())
+
+
+def test_dogfood_model_builders():
+    from paddle_tpu.models.image import lenet5
+
+    img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    probs = lenet5(img, class_dim=10)
+    loss = fluid.layers.mean(
+        x=fluid.layers.cross_entropy(input=probs, label=label))
+    fluid.optimizer.MomentumOptimizer(learning_rate=0.01,
+                                      momentum=0.9).minimize(loss)
+    rep = analysis.check_program(fluid.default_main_program(),
+                                 fetches=[loss.name], publish=False)
+    assert rep.ok(), rep.format()
+    assert not rep.warnings, rep.format()
+
+
+# ---------------------------------------------------------------------------
+# memory-optimize delegation: identical reuse decisions
+# ---------------------------------------------------------------------------
+
+class _OriginalCFG:
+    """The pre-refactor ControlFlowGraph, verbatim (liveness seeded
+    empty, same fixpoint) — the regression oracle proving the
+    analysis.dataflow delegation changed NOTHING about reuse."""
+
+    def __init__(self, program):
+        self._program = program
+        block = program.global_block()
+        self._ops = list(block.desc.ops)
+        self._uses = [set(od.input_names()) - {"@EMPTY@"}
+                      for od in self._ops]
+        self._defs = [set(od.output_names()) - {"@EMPTY@"}
+                      for od in self._ops]
+        self._live_in = [set() for _ in self._ops]
+        self._live_out = [set() for _ in self._ops]
+
+    def analyze(self):
+        changed = True
+        n = len(self._ops)
+        while changed:
+            changed = False
+            for i in reversed(range(n)):
+                live_out = set()
+                if i + 1 < n:
+                    live_out = self._live_in[i + 1]
+                live_in = self._uses[i] | (live_out - self._defs[i])
+                if live_in != self._live_in[i] or \
+                        live_out != self._live_out[i]:
+                    self._live_in[i] = live_in
+                    self._live_out[i] = live_out
+                    changed = True
+        return self
+
+    def reuse_candidates(self):
+        from collections import defaultdict
+
+        persist = {n for n, v in
+                   self._program.global_block().vars.items()
+                   if getattr(v, "persistable", False)}
+        released = defaultdict(list)
+        for i in range(len(self._ops)):
+            dead = (self._live_in[i] | self._defs[i]) - \
+                self._live_out[i]
+            for name in sorted(dead - persist):
+                released[i].append(name)
+        return dict(released)
+
+
+def _build_mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = x
+        for _ in range(3):
+            h = fluid.layers.fc(input=h, size=8, act="relu")
+        out = fluid.layers.mean(x=h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(out)
+    return main, out
+
+
+def test_memory_optimize_identical_reuse_decisions():
+    from paddle_tpu.fluid import memory_optimization_transpiler as mot
+
+    # two identical builds (per-program name counters make them agree)
+    prog_a, out_a = _build_mlp_program()
+    prog_b, out_b = _build_mlp_program()
+    assert prog_a.desc.serialize_to_string() == \
+        prog_b.desc.serialize_to_string()
+
+    new_cfg = mot.ControlFlowGraph(prog_a).analyze()
+    old_cfg = _OriginalCFG(prog_b).analyze()
+    assert new_cfg._live_in == old_cfg._live_in
+    assert new_cfg._live_out == old_cfg._live_out
+    assert new_cfg.reuse_candidates() == old_cfg.reuse_candidates()
+
+    # the full rewrite makes the SAME renames whichever liveness
+    # implementation drives it
+    renames_new = mot._rewrite_for_reuse(prog_a, new_cfg,
+                                         {out_a.name})
+    renames_old = mot._rewrite_for_reuse(prog_b, old_cfg,
+                                         {out_b.name})
+    assert renames_new == renames_old
+    assert renames_new, "expected reuse in a 3-layer MLP"
+
+
+def test_memory_optimized_program_verifies():
+    """The rewrite's output is itself a verifier client: slot adoption
+    must not manufacture use-before-def or hazards."""
+    prog, out = _build_mlp_program()
+    fluid.memory_optimize(prog, skip_opt_set=[out.name])
+    rep = analysis.check_program(prog, publish=False)
+    assert rep.ok(), rep.format()
+
+
+# ---------------------------------------------------------------------------
+# framework.InferShapeError identity
+# ---------------------------------------------------------------------------
+
+def test_infer_shape_error_names_op_and_var():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.layers.data(name="x", shape=[4], dtype="float32")
+        block = main.global_block()
+        with pytest.raises(framework.InferShapeError) as ei:
+            block.append_op(type="mul",
+                            inputs={"X": ["x"], "Y": ["missing_w"]},
+                            outputs={"Out": ["z"]})
+    err = ei.value
+    assert err.op_type == "mul"
+    assert err.op_index is not None and err.block_idx == 0
+    assert err.var_name == "missing_w"
+    assert "mul" in str(err) and "missing_w" in str(err)
+
+
+def test_infer_shape_error_on_bad_algebra():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[3, 4], dtype="float32",
+                              append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[5, 6], dtype="float32",
+                              append_batch_size=False)
+        block = main.global_block()
+        block.create_var(name="z", dtype="float32", shape=(3, 6))
+        with pytest.raises(framework.InferShapeError) as ei:
+            block.append_op(type="mul",
+                            inputs={"X": [a], "Y": [b]},
+                            outputs={"Out": ["z"]})
+    assert ei.value.op_type == "mul"
+    assert "mul" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_lint_cli_selftest_inprocess(capsys):
+    from paddle_tpu.tools import lint_cli
+
+    assert lint_cli.main(["--selftest"]) == 0
+    assert "selftest green" in capsys.readouterr().out
+
+
+def test_lint_cli_golden(capsys):
+    from paddle_tpu.tools import lint_cli
+
+    assert lint_cli.main(["--golden", "--quiet"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out and "transformer.json" in out
+    # --json over the fixture set is ONE parseable document
+    assert lint_cli.main(["--golden", "--json"]) == 0
+    docs = json.loads(capsys.readouterr().out)
+    assert isinstance(docs, list) and len(docs) >= 5
+    assert all(d["errors"] == 0 for d in docs)
+
+
+def test_lint_cli_model_dir(tmp_path, capsys):
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.tools import lint_cli
+
+    main, startup, loss = _build_train()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid_io.save_inference_model(
+            str(tmp_path), ["x", "y"], [loss], exe, main_program=main,
+            bucket_hints={"batch_buckets": [1, 8]})
+    assert lint_cli.main([str(tmp_path), "--quiet"]) == 0
+    # the export carries no training-tail debris: prune drops
+    # unreferenced VarDescs, so no grad-orphan/dead-var findings
+    assert "0 warning(s)" in capsys.readouterr().out
+    # corrupt it: exit code goes red and the code is printed
+    path = os.path.join(str(tmp_path), "__model__")
+    with open(path) as f:
+        meta = json.load(f)
+    meta["program"]["blocks"][0]["ops"][0]["type"] = "nope_op"
+    with open(path, "w") as f:
+        json.dump(meta, f)
+    capsys.readouterr()
+    assert lint_cli.main([str(tmp_path), "--quiet"]) == 1
+    assert "V001" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# report plumbing
+# ---------------------------------------------------------------------------
+
+def test_report_counters_published():
+    from paddle_tpu.obs import registry as obs_registry
+
+    main, _, _ = _build_train()
+    main.desc.block(0).ops[1].type = "definitely_not_an_op"
+    analysis.check_program(main, origin="test")
+    reg = obs_registry.get_registry()
+    fam = reg.counter("analysis_diagnostics_total",
+                      labelnames=("code", "severity"))
+    assert fam.labels(code="V001", severity="error").value >= 1
+    runs = reg.counter("analysis_runs_total", labelnames=("origin",))
+    assert runs.labels(origin="test").value == 1
+
+
+def test_suppression_variants():
+    main, _, _ = _build_train()
+    main.desc.block(0).ops[1].type = "definitely_not_an_op"
+    by_code = analysis.verify_program(main, suppress=("V001",),
+                                      level="structural")
+    assert not by_code.has("V001") and by_code.suppressed
+    by_op = analysis.verify_program(
+        main, suppress=("V001@definitely_not_an_op",),
+        level="structural")
+    assert not by_op.has("V001")
+    unrelated = analysis.verify_program(main, suppress=("V001@other",),
+                                        level="structural")
+    assert unrelated.has("V001")
