@@ -13,6 +13,11 @@ Three layers (docs/ANALYSIS.md documents every diagnostic code):
   * `lints`     — TPU-specific rules: dynamic dims into MXU ops,
     jit-segment splits, unseeded RNG, AMP dtype mixes, grad orphans
     (L0xx codes).
+  * `shard`     — static SPMD analysis: PartitionSpec propagation
+    against a mesh description, divisibility/conflict/schedule
+    checks, per-device peak-HBM estimation (S0xx codes), with the
+    `costmodel` pricing the implied ICI collectives
+    (`shard_comm_bytes_total{collective}`).
 
 `check_program` runs all three and publishes finding counters into the
 obs registry; the sibling roofline COST analyzer lives in
@@ -30,11 +35,15 @@ from .diagnostics import (Diagnostic, ProgramVerificationError, Report,
 from .dataflow import Liveness, analyze_dataflow
 from .lints import lint_program
 from .verifier import verify_program
+from .shard import (analyze_sharding, check_moe, check_pipeline,
+                    check_ring, mesh_axis_sizes, ShardingPlan)
+from .costmodel import CommCostReport
 
 __all__ = [
     "Diagnostic", "Severity", "Report", "ProgramVerificationError",
     "Liveness", "verify_program", "analyze_dataflow", "lint_program",
-    "check_program",
+    "check_program", "analyze_sharding", "check_pipeline", "check_moe",
+    "check_ring", "mesh_axis_sizes", "ShardingPlan", "CommCostReport",
 ]
 
 

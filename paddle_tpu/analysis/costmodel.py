@@ -1,0 +1,147 @@
+"""Static communication cost model for sharded programs.
+
+Given the spec assignment the sharding analyzer (`analysis.shard`)
+derives, this module prices the ICI collectives a training step
+implies — gradient all-reduce over dp, partial-sum all-reduce when a
+matmul contracts a sharded dim, reduce-scatter/all-gather under
+ZeRO-1, ppermute hops for ring attention, implicit-reshard
+all-gathers at S003 conflict points — in BYTES ON THE WIRE per step.
+
+Wire bytes use the standard ring-algorithm costs (what XLA's
+collective implementations converge to on a torus):
+
+    all-reduce       2 * (n-1)/n * payload
+    all-gather       (n-1)/n * gathered payload
+    reduce-scatter   (n-1)/n * payload
+    all-to-all       (n-1)/n * payload
+    ppermute         payload (one neighbor hop)
+
+This is a RANKING model, not a simulator: overlap with compute,
+latency terms, and multi-hop torus routing are out of scope.  Its job
+is to say which tensors dominate the step's communication and how the
+total scales with the mesh — before anything compiles.  Totals land in
+the obs registry as `shard_comm_bytes_total{collective}` so proglint
+runs and trainer-boundary analyses leave a scrapeable trail
+(docs/OBSERVABILITY.md).
+
+The sibling COMPUTE cost model is `fluid/analysis.py` (roofline
+FLOPs/HBM floors); this one prices the wires between the chips.
+"""
+
+from collections import OrderedDict
+
+__all__ = ["CommCostReport", "collective_wire_bytes",
+           "DEFAULT_ICI_GBPS"]
+
+# v5e-class ICI bandwidth per chip (all links), GB/s; override per call
+DEFAULT_ICI_GBPS = 90.0
+
+COLLECTIVES = ("allreduce", "reducescatter", "allgather", "alltoall",
+               "ppermute")
+
+
+def collective_wire_bytes(collective, payload_bytes, n):
+    """Ring-cost wire bytes for moving `payload_bytes` across `n`
+    participants."""
+    if n <= 1:
+        return 0
+    if collective == "allreduce":
+        return int(2.0 * (n - 1) / n * payload_bytes)
+    if collective in ("allgather", "reducescatter", "alltoall"):
+        return int(1.0 * (n - 1) / n * payload_bytes)
+    if collective == "ppermute":
+        return int(payload_bytes)
+    raise ValueError("unknown collective %r (one of %s)"
+                     % (collective, ", ".join(COLLECTIVES)))
+
+
+class CommEvent:
+    """One collective a step implies."""
+
+    __slots__ = ("collective", "axis", "n", "payload_bytes",
+                 "wire_bytes", "detail")
+
+    def __init__(self, collective, axis, n, payload_bytes, detail=""):
+        self.collective = collective
+        self.axis = axis
+        self.n = int(n)
+        self.payload_bytes = int(payload_bytes)
+        self.wire_bytes = collective_wire_bytes(collective,
+                                                payload_bytes, n)
+        self.detail = detail
+
+    def to_dict(self):
+        return {"collective": self.collective, "axis": self.axis,
+                "n": self.n, "payload_bytes": self.payload_bytes,
+                "wire_bytes": self.wire_bytes, "detail": self.detail}
+
+    def __repr__(self):
+        return ("CommEvent(%s over %s[%d]: %d wire bytes, %s)"
+                % (self.collective, self.axis, self.n, self.wire_bytes,
+                   self.detail))
+
+
+class CommCostReport:
+    """Accumulates CommEvents and ranks them."""
+
+    def __init__(self, ici_gbps=DEFAULT_ICI_GBPS):
+        self.events = []
+        self.ici_gbps = ici_gbps
+
+    def add(self, collective, axis, n, payload_bytes, detail=""):
+        if n <= 1 or payload_bytes <= 0:
+            return None  # a 1-wide axis moves nothing
+        ev = CommEvent(collective, axis, n, payload_bytes, detail)
+        self.events.append(ev)
+        return ev
+
+    def totals(self):
+        """{collective: wire bytes}, descending."""
+        out = {}
+        for ev in self.events:
+            out[ev.collective] = out.get(ev.collective, 0) + ev.wire_bytes
+        return OrderedDict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def total_wire_bytes(self):
+        return sum(ev.wire_bytes for ev in self.events)
+
+    def step_seconds_floor(self):
+        """Serialized ICI time floor (no overlap assumed)."""
+        return self.total_wire_bytes() / (self.ici_gbps * 1e9)
+
+    def ranked(self, topk=None):
+        evs = sorted(self.events, key=lambda e: -e.wire_bytes)
+        return evs if topk is None else evs[:topk]
+
+    def to_dict(self, topk=10):
+        return {
+            "totals": dict(self.totals()),
+            "total_wire_bytes": self.total_wire_bytes(),
+            "step_seconds_floor": self.step_seconds_floor(),
+            "top": [ev.to_dict() for ev in self.ranked(topk)],
+        }
+
+    def format(self, topk=10):
+        lines = ["comm cost (per step, ring-cost wire bytes):"]
+        for coll, b in self.totals().items():
+            lines.append("  %-14s %12d bytes" % (coll, b))
+        for ev in self.ranked(topk):
+            lines.append("    %-12s %s[%d] %10d B  %s"
+                         % (ev.collective, ev.axis, ev.n,
+                            ev.wire_bytes, ev.detail))
+        return "\n".join(lines)
+
+    def publish(self):
+        """Count total wire bytes per collective into the obs registry
+        (`shard_comm_bytes_total{collective}`)."""
+        from ..obs import registry as registry_mod
+
+        reg = registry_mod.get_registry()
+        fam = reg.counter(
+            "shard_comm_bytes_total",
+            "static per-step ICI wire bytes estimated by the sharding "
+            "analyzer, by collective",
+            labelnames=("collective",))
+        for coll, b in self.totals().items():
+            fam.labels(collective=coll).inc(b)
+        return self

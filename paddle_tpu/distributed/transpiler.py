@@ -64,6 +64,51 @@ def split_dense_variable(var_list, pserver_count, min_block_size=1024,
     return blocks
 
 
+def _validate_split_blocks(assign, params, endpoints):
+    """Every parameter's send/recv blocks must tile [0, numel) exactly:
+    contiguous, non-overlapping, fully covering, each on a known
+    endpoint.  A custom split_method that gets this wrong would
+    otherwise surface as silently-corrupted parameters after the first
+    init_pservers round-trip; fail at transpile time instead, naming
+    the parameter and the first bad block."""
+    numel = {p.name: int(np.prod(p.shape)) for p in params}
+    dropped = sorted(set(numel) - set(assign))
+    if dropped:
+        raise ValueError(
+            "split assigned no pserver blocks to parameter(s) %s — "
+            "they would silently stay at their initial values on "
+            "every trainer" % dropped)
+    for pname, blocks in assign.items():
+        total = numel.get(pname)
+        if total is None:
+            raise ValueError(
+                "split assigned blocks to %r, which is not a "
+                "parameter being distributed" % pname)
+        cursor = 0
+        for ep, begin, size in sorted(blocks, key=lambda b: b[1]):
+            if ep not in endpoints:
+                raise ValueError(
+                    "param %r block [%d:%d) is assigned to unknown "
+                    "pserver endpoint %r" % (pname, begin,
+                                             begin + size, ep))
+            if size <= 0:
+                raise ValueError(
+                    "param %r has an empty/negative block at offset "
+                    "%d (size %d)" % (pname, begin, size))
+            if begin != cursor:
+                kind = "overlaps" if begin < cursor else "leaves a gap"
+                raise ValueError(
+                    "param %r split %s at offset %d: block [%d:%d) "
+                    "after [..:%d)" % (pname, kind, cursor, begin,
+                                       begin + size, cursor))
+            cursor = begin + size
+        if cursor != total:
+            raise ValueError(
+                "param %r split covers %d of %d elements — the "
+                "pserver would train a truncated parameter"
+                % (pname, cursor, total))
+
+
 class DistributeTranspiler:
     """reference: distribute_transpiler.py DistributeTranspiler:81."""
 
@@ -197,6 +242,10 @@ class DistributeTranspiler:
         for j, p in enumerate(p for p in params if p.name in sparse):
             assign[p.name] = [(endpoints[j % len(endpoints)], 0,
                                int(np.prod(p.shape)))]
+        # a bad split_method here means every trainer ships wrong byte
+        # ranges to every pserver — validate the tiling NOW, before
+        # the rewrite lands in the program
+        _validate_split_blocks(assign, params, set(endpoints))
         self.param_blocks = assign
 
         # drop the optimizer ops (+ their lr decay helpers stay; they're

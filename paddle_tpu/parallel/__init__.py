@@ -15,24 +15,31 @@ XLA GSPMD partition the computation and insert the ICI collectives
 (all-reduce/all-gather/reduce-scatter) that replace NCCL and the ring.
 """
 
-from .mesh import make_mesh, MeshConfig
-from .sharding import (param_spec, batch_spec, shard_state, shard_feeds,
-                       replicated)
-from .trainer import ParallelTrainer, make_parallel_step
-from .ring import ring_attention, ulysses_attention, sp_shard_map
+from .mesh import make_mesh, MeshConfig, parse_mesh_spec
+from .sharding import (param_spec, param_spec_reason, batch_spec,
+                       shard_state, shard_feeds, replicated, zero1_spec,
+                       zero1_spec_reason)
+from .trainer import ParallelTrainer, make_parallel_step, verify_sharding
+from .ring import (ring_attention, ulysses_attention, sp_shard_map,
+                   sp_axis_info)
 from .pipeline import (gpipe_spmd, pipeline_apply, split_microbatches,
-                       stack_stage_params)
-from .moe import switch_moe, moe_shard_map, init_moe_params
+                       stack_stage_params, pipeline_schedule_info)
+from .moe import (switch_moe, moe_shard_map, init_moe_params,
+                  expert_capacity, moe_axis_info)
 from .program_api import (lower_program_fn, PipelineProgramTrainer,
                           MoEProgramLayer)
 from .optim import PytreeOptimizer
 
 __all__ = [
-    "make_mesh", "MeshConfig", "param_spec", "batch_spec", "shard_state",
-    "shard_feeds", "replicated", "ParallelTrainer", "make_parallel_step",
+    "make_mesh", "MeshConfig", "parse_mesh_spec", "param_spec",
+    "param_spec_reason", "batch_spec", "shard_state", "shard_feeds",
+    "replicated", "zero1_spec", "zero1_spec_reason", "ParallelTrainer",
+    "make_parallel_step", "verify_sharding",
     "ring_attention", "ulysses_attention", "sp_shard_map",
-    "gpipe_spmd", "pipeline_apply", "split_microbatches",
-    "stack_stage_params", "switch_moe", "moe_shard_map",
-    "init_moe_params", "lower_program_fn", "PipelineProgramTrainer",
-    "MoEProgramLayer", "PytreeOptimizer",
+    "sp_axis_info", "gpipe_spmd", "pipeline_apply",
+    "split_microbatches", "stack_stage_params",
+    "pipeline_schedule_info", "switch_moe", "moe_shard_map",
+    "init_moe_params", "expert_capacity", "moe_axis_info",
+    "lower_program_fn", "PipelineProgramTrainer", "MoEProgramLayer",
+    "PytreeOptimizer",
 ]

@@ -7,12 +7,18 @@ named axes is the TPU-native "communicator": collectives are implied by
 shardings over its axes and ride ICI.
 """
 
+from collections import OrderedDict
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "MeshConfig"]
+__all__ = ["make_mesh", "MeshConfig", "parse_mesh_spec"]
+
+# the canonical axis vocabulary (docs/ANALYSIS.md "mesh axes"):
+# dp data, mp model/tensor, sp sequence, pp pipeline, ep expert
+AXIS_NAMES = ("dp", "mp", "sp", "pp", "ep")
 
 
 class MeshConfig:
@@ -20,14 +26,111 @@ class MeshConfig:
 
     dp: data parallel (batch) — gradient all-reduce rides this axis.
     mp: model/tensor parallel — weight shards; matmul partials reduce here.
-    Extended axes (pp pipeline, sp sequence) are carved out of the same
-    device list by callers that need them.
+    sp/pp/ep: sequence / pipeline / expert parallelism over the same
+    device list.
+
+    A MeshConfig is a *static* mesh description: `.shape` exposes the
+    same axis-name -> size mapping a built `jax.sharding.Mesh` has, so
+    the sharding analyzer (`paddle_tpu.analysis.shard`) and the spec
+    helpers in `sharding.py` accept either one — no devices needed to
+    reason about a layout.
     """
 
-    def __init__(self, dp=None, mp=1, axes=("dp", "mp")):
+    def __init__(self, dp=None, mp=1, sp=1, pp=1, ep=1, axes=None):
         self.dp = dp
         self.mp = mp
+        self.sp = sp
+        self.pp = pp
+        self.ep = ep
+        sizes = {"dp": dp, "mp": mp, "sp": sp, "pp": pp, "ep": ep}
+        if axes is None:
+            axes = ("dp", "mp") if (sp == pp == ep == 1) else tuple(
+                a for a in AXIS_NAMES
+                if a == "dp" or (sizes[a] or 1) > 1)
         self.axes = tuple(axes)
+
+    @property
+    def shape(self):
+        """axis name -> size, in axis order (a dp of None means
+        'whatever devices remain' and reads as size 1 here)."""
+        sizes = {"dp": self.dp, "mp": self.mp, "sp": self.sp,
+                 "pp": self.pp, "ep": self.ep}
+        return OrderedDict(
+            (a, int(sizes.get(a) or 1)) for a in self.axes)
+
+    def validate(self, n_devices):
+        """Check the axis product against a device count; raises a
+        ValueError NAMING the axes (instead of the opaque numpy
+        reshape error a bad product used to surface as)."""
+        shape = self.shape
+        product = int(np.prod(list(shape.values()))) if shape else 1
+        if self.dp is None:
+            denom = int(np.prod(
+                [s for a, s in shape.items() if a != "dp"]))
+            if denom == 0 or n_devices % denom:
+                raise ValueError(
+                    "%d device(s) not divisible by the non-dp axis "
+                    "product %s = %d" % (n_devices, _axis_product_str(
+                        {a: s for a, s in shape.items() if a != "dp"}),
+                        denom))
+        elif product != n_devices:
+            raise ValueError(
+                "mesh axis product %s = %d != %d device(s); resize an "
+                "axis or the device set" % (_axis_product_str(shape),
+                                            product, n_devices))
+        return self
+
+    @classmethod
+    def parse(cls, spec):
+        """Parse "dp=4,mp=2"-style mesh specs (the proglint --mesh
+        syntax) into a MeshConfig with that exact axis order."""
+        return parse_mesh_spec(spec)
+
+    def __repr__(self):
+        return "MeshConfig(%s)" % ",".join(
+            "%s=%d" % (a, s) for a, s in self.shape.items())
+
+
+def _axis_product_str(shape):
+    return " * ".join("%s=%s" % (a, s) for a, s in shape.items()) \
+        or "(no axes)"
+
+
+def parse_mesh_spec(spec):
+    """"dp=4,mp=2" -> MeshConfig(dp=4, mp=2, axes=("dp", "mp"))."""
+    if isinstance(spec, MeshConfig):
+        return spec
+    sizes, axes = {}, []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                "bad mesh spec %r: expected comma-separated axis=size "
+                "pairs like 'dp=4,mp=2'" % (spec,))
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in AXIS_NAMES:
+            raise ValueError(
+                "bad mesh spec %r: unknown axis %r (axes are %s)"
+                % (spec, name, "/".join(AXIS_NAMES)))
+        try:
+            size = int(val)
+        except ValueError:
+            raise ValueError("bad mesh spec %r: size of axis %r is not "
+                             "an integer" % (spec, name))
+        if size < 1:
+            raise ValueError("bad mesh spec %r: axis %r must be >= 1"
+                             % (spec, name))
+        if name in sizes:
+            raise ValueError("bad mesh spec %r: axis %r named twice"
+                             % (spec, name))
+        sizes[name] = size
+        axes.append(name)
+    if not axes:
+        raise ValueError("bad mesh spec %r: no axes" % (spec,))
+    return MeshConfig(axes=tuple(axes), **sizes)
 
 
 def make_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
@@ -98,12 +201,20 @@ def make_mesh(n_devices=None, dp=None, mp=1, sp=1, pp=1, ep=1,
     denom = int(np.prod([sizes[a] for a in axes if a != dp_name]))
     if dp is None:
         if n_devices % denom != 0:
-            raise ValueError("n_devices %d not divisible by %d (product "
-                             "of non-dp axes)" % (n_devices, denom))
+            raise ValueError(
+                "%d device(s) not divisible by the non-%s axis product "
+                "%s = %d; resize an axis or pass %s explicitly"
+                % (n_devices, dp_name,
+                   _axis_product_str({a: sizes[a] for a in axes
+                                      if a != dp_name}), denom, dp_name))
         dp = n_devices // denom
     if dp * denom != n_devices:
-        raise ValueError("axis product (%d*%d) != n_devices %d"
-                         % (dp, denom, n_devices))
+        raise ValueError(
+            "mesh axis product %s = %d != %d device(s); resize an axis "
+            "or the device set"
+            % (_axis_product_str(
+                {a: (dp if a == dp_name else sizes[a]) for a in axes}),
+               dp * denom, n_devices))
     sizes[dp_name] = dp
     if drop_unit_axes:
         # "dp" always survives: batch_spec / trainer / moe default to a

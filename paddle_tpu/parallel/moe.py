@@ -22,7 +22,36 @@ from jax.sharding import PartitionSpec as P
 
 from .sharding import shard_map_norep
 
-__all__ = ["switch_moe", "moe_shard_map", "init_moe_params"]
+__all__ = ["switch_moe", "moe_shard_map", "init_moe_params",
+           "expert_capacity", "moe_axis_info"]
+
+
+def expert_capacity(tokens, n_experts, capacity_factor):
+    """Per-expert capacity slots for `tokens` local tokens — THE
+    capacity formula (switch_moe and the sharding analyzer's S004
+    overflow check both use it, so they can never disagree)."""
+    return max(1, int(capacity_factor * tokens / max(n_experts, 1)))
+
+
+def moe_axis_info(mesh, n_experts, axis_name="ep", batch_axis="dp",
+                  capacity_factor=1.25, tokens=None):
+    """Static introspection of an MoE layout over `mesh` (or any
+    axis->size mapping): expert ownership, token sharding, and
+    capacity — what the analyzer's `check_moe` consumes."""
+    shape = dict(getattr(mesh, "shape", mesh))
+    ep = int(shape.get(axis_name, 1))
+    dp = int(shape.get(batch_axis, 1))
+    info = {"axis": axis_name, "ep": ep, "batch_axis": batch_axis,
+            "n_experts": n_experts, "experts_per_device":
+            (n_experts // ep if ep and n_experts % ep == 0 else None),
+            "token_shards": ep * dp}
+    if tokens is not None and info["token_shards"] \
+            and tokens % info["token_shards"] == 0:
+        local = tokens // info["token_shards"]
+        info["local_tokens"] = local
+        info["capacity"] = expert_capacity(local, n_experts,
+                                           capacity_factor)
+    return info
 
 
 def init_moe_params(key, d_model, d_hidden, n_experts, dtype=jnp.float32):
@@ -77,7 +106,7 @@ def switch_moe(params, x, axis_name="ep", capacity_factor=1.25,
                             dtype=jnp.float32)         # [b, E]
 
     # --- pack into capacity slots (per source device, per expert) ---
-    capacity = max(1, int(capacity_factor * b / n_expert))
+    capacity = expert_capacity(b, n_expert, capacity_factor)
     pos = jnp.cumsum(onehot, axis=0) - 1.0             # queue position
     in_cap = (pos < capacity) * onehot                 # dropped past C
     # dispatch is the single place capacity masking happens: one_hot of

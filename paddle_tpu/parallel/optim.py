@@ -41,6 +41,27 @@ class PytreeOptimizer:
                 "variables live in programs)")
         self._lr = lr
 
+    @property
+    def slot_names(self):
+        """Names of the per-parameter accumulator slots this rule
+        carries (velocity/moment/...), for spec introspection."""
+        return [spec.name for spec in self._rule.state_slots]
+
+    def state_specs(self, param_specs):
+        """PartitionSpecs for the state pytree `init` builds: each
+        accumulator slot shards exactly like the parameter it tracks
+        (the schedules stream state alongside params), shared scalars
+        replicate.  `param_specs` is the params-pytree of specs."""
+        import jax
+
+        return {
+            "slots": {name: jax.tree_util.tree_map(lambda s: s,
+                                                   param_specs)
+                      for name in self.slot_names},
+            "shared": {spec.name: None
+                       for spec in self._rule.shared_scalars},
+        }
+
     def init(self, params):
         """State pytree: one zeros-like per (state slot, param leaf),
         plus the shared scalars at their initial values."""

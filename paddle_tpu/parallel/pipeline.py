@@ -30,7 +30,26 @@ from ..obs import trace as obs_trace
 from .sharding import shard_map_norep
 
 __all__ = ["gpipe_spmd", "pipeline_apply", "split_microbatches",
-           "stack_stage_params"]
+           "stack_stage_params", "pipeline_schedule_info"]
+
+
+def pipeline_schedule_info(mesh, n_microbatches, axis_name="pp",
+                           batch_size=None):
+    """Static introspection of a GPipe schedule over `mesh` (or any
+    axis->size mapping): stage count, tick count, bubble fraction —
+    what the sharding analyzer's `check_pipeline` consumes."""
+    shape = dict(getattr(mesh, "shape", mesh))
+    s = int(shape.get(axis_name, 0))
+    m = int(n_microbatches)
+    info = {"axis": axis_name, "stages": s, "microbatches": m,
+            "ticks": (m + s - 1) if s else None,
+            "bubble_fraction": (float(s - 1) / (m + s - 1)
+                                if s and (m + s - 1) else None)}
+    if batch_size is not None:
+        info["microbatch_size"] = (batch_size // m
+                                   if m and batch_size % m == 0
+                                   else None)
+    return info
 
 
 def split_microbatches(x, n_microbatches):
@@ -105,6 +124,16 @@ def pipeline_apply(mesh, stage_fn, stacked_params, x, n_microbatches,
     "dp" axis in the mesh the microbatch dimension is dp-sharded too.
     Returns [B, ...] outputs of the final stage.
     """
+    from ..utils import flags as _flags
+
+    if _flags.get_flag("verify_sharding"):
+        from ..analysis import shard as _shard
+
+        _shard.check_pipeline(
+            mesh, n_stages=jax.tree_util.tree_leaves(
+                stacked_params)[0].shape[0],
+            n_microbatches=n_microbatches, axis_name=axis_name,
+            batch_size=int(x.shape[0])).raise_on_error()
     s = mesh.shape[axis_name]
     n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if n_stages != s:

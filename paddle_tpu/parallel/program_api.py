@@ -90,6 +90,15 @@ class PipelineProgramTrainer:
         if optimizer is None:
             optimizer = MomentumOptimizer(learning_rate=lr, momentum=0.9)
         self.optimizer = PytreeOptimizer(optimizer)
+        from ..utils import flags as _flags
+
+        if _flags.get_flag("verify_sharding"):
+            from ..analysis import shard as _shard
+
+            _shard.check_pipeline(
+                mesh, n_stages=mesh.shape.get(pp_axis, 0),
+                n_microbatches=n_microbatches,
+                axis_name=pp_axis).raise_on_error()
         n_stages = mesh.shape[pp_axis]
         fns, states = [], []
         for i in range(n_stages):
@@ -146,6 +155,15 @@ class MoEProgramLayer:
     def __init__(self, build_expert, n_experts, d_model, mesh,
                  ep_axis="ep", batch_axis="dp", capacity_factor=1.25,
                  seed=0):
+        from ..utils import flags as _flags
+
+        if _flags.get_flag("verify_sharding"):
+            from ..analysis import shard as _shard
+
+            _shard.check_moe(
+                mesh, n_experts, capacity_factor=capacity_factor,
+                axis_name=ep_axis,
+                batch_axis=batch_axis).raise_on_error()
         expert_states, fns = [], []
         for e in range(n_experts):
             program, startup, feed, fetch = build_expert()

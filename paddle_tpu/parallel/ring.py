@@ -23,7 +23,29 @@ from jax.sharding import PartitionSpec as P
 from .sharding import shard_map_norep
 from ..kernels.flash_attention import flash_attention, NEG_INF
 
-__all__ = ["ring_attention", "ulysses_attention", "sp_shard_map"]
+__all__ = ["ring_attention", "ulysses_attention", "sp_shard_map",
+           "sp_axis_info"]
+
+
+def sp_axis_info(mesh, seq_len=None, n_heads=None, axis_name="sp",
+                 mode="ring"):
+    """Static introspection of a sequence-parallel layout over `mesh`
+    (or any axis->size mapping): shard extent and the divisibility
+    requirements the schedule imposes — what the sharding analyzer's
+    `check_ring` consumes."""
+    shape = dict(getattr(mesh, "shape", mesh))
+    sp = int(shape.get(axis_name, 0))
+    info = {"axis": axis_name, "sp": sp, "mode": mode,
+            "requires": ["seq_len %% %d == 0" % sp] if sp else []}
+    if mode == "ulysses" and sp:
+        info["requires"].append("n_heads %% %d == 0" % sp)
+    if seq_len is not None and sp:
+        info["local_seq"] = (seq_len // sp if seq_len % sp == 0
+                             else None)
+    if n_heads is not None and sp and mode == "ulysses":
+        info["local_heads"] = (n_heads // sp if n_heads % sp == 0
+                               else None)
+    return info
 
 
 def _block_attend(q, k, v, sm_scale, causal, q_start, k_start):

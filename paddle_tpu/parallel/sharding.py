@@ -11,12 +11,51 @@ import re as _re
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["param_spec", "batch_spec", "replicated", "shard_state",
-           "shard_feeds"]
+__all__ = ["param_spec", "param_spec_reason", "batch_spec", "replicated",
+           "shard_state", "shard_feeds", "zero1_spec",
+           "zero1_spec_reason"]
 
 
 def replicated(mesh):
     return NamedSharding(mesh, P())
+
+
+def param_spec_reason(name, shape, mesh, mp_axis="mp", min_shard_dim=512):
+    """(spec, reason) for a parameter under the default tensor-parallel
+    layout.  `reason` is None when the spec shards (or replication is
+    deliberate policy: no mp axis, or a non-2-D tensor the conv policy
+    replicates on purpose); otherwise it is a sentence explaining what
+    FORCED replication (min_shard_dim or divisibility) — the sharding
+    analyzer's S001 cites it instead of letting the fallback stay
+    silent."""
+    if mp_axis not in mesh.shape:
+        return P(), None
+    mp = mesh.shape[mp_axis]
+    if mp == 1:
+        return P(), None
+    if len(shape) != 2:
+        return P(), None  # conv filters / biases / stats: policy
+    rows, cols = int(shape[0]), int(shape[1])
+    # embedding / big row-major tables: shard rows
+    if rows >= min_shard_dim * mp and rows % mp == 0 and rows >= cols:
+        return P(mp_axis, None), None
+    if cols % mp == 0 and cols >= min_shard_dim:
+        return P(None, mp_axis), None
+    if rows % mp == 0 and rows >= min_shard_dim:
+        return P(mp_axis, None), None
+    if max(rows, cols) < min_shard_dim:
+        reason = ("both dims of (%d, %d) are below min_shard_dim %d"
+                  % (rows, cols, min_shard_dim))
+    elif cols >= min_shard_dim and cols % mp:
+        reason = ("cols %d not divisible by %s=%d (rows %d %s)"
+                  % (cols, mp_axis, mp,
+                     rows, "not divisible either" if rows % mp
+                     else "below min_shard_dim %d" % min_shard_dim))
+    else:
+        reason = ("rows %d not divisible by %s=%d and cols %d below "
+                  "min_shard_dim %d" % (rows, mp_axis, mp, cols,
+                                        min_shard_dim))
+    return P(), reason
 
 
 def param_spec(name, shape, mesh, mp_axis="mp", min_shard_dim=512):
@@ -28,23 +67,12 @@ def param_spec(name, shape, mesh, mp_axis="mp", min_shard_dim=512):
     reference: pserver/ParameterServer2.h:73, distribute_transpiler.py:39);
     everything else (conv filters, biases, BN stats) is replicated — conv
     weights are small relative to activations, and replication keeps the
-    conv spatially partitionable by dp.
+    conv spatially partitionable by dp.  See `param_spec_reason` for the
+    variant that also says WHY a tensor fell back to replication.
     """
-    if mp_axis not in mesh.shape:
-        return P()
-    mp = mesh.shape[mp_axis]
-    if mp == 1:
-        return P()
-    if len(shape) == 2:
-        rows, cols = int(shape[0]), int(shape[1])
-        # embedding / big row-major tables: shard rows
-        if rows >= min_shard_dim * mp and rows % mp == 0 and rows >= cols:
-            return P(mp_axis, None)
-        if cols % mp == 0 and cols >= min_shard_dim:
-            return P(None, mp_axis)
-        if rows % mp == 0 and rows >= min_shard_dim:
-            return P(mp_axis, None)
-    return P()
+    spec, _reason = param_spec_reason(name, shape, mesh, mp_axis=mp_axis,
+                                      min_shard_dim=min_shard_dim)
+    return spec
 
 
 def batch_spec(shape, mesh, dp_axis="dp"):
@@ -114,21 +142,40 @@ def is_optimizer_state(name, known=None):
     return bool(_ACC_NAME.search(name))
 
 
-def zero1_spec(base_spec, shape, mesh, dp_axis="dp"):
-    """ZeRO-1: shard an optimizer-state tensor over the dp axis on its
-    first free, divisible dim (on top of any mp sharding the matching
-    parameter has).  GSPMD then reduce-scatters the gradient into the
-    shard-wise accumulator update and all-gathers the updated params —
-    all-reduce bandwidth, 1/dp optimizer-state memory."""
+def zero1_spec_reason(base_spec, shape, mesh, dp_axis="dp"):
+    """(spec, reason) for the ZeRO-1 layout of an optimizer-state
+    tensor.  `reason` is None when a dim sharded (or there is no dp
+    axis to shard over); otherwise it says why every dim stayed whole —
+    the S001 citation for optimizer state that silently keeps dp full
+    copies."""
     if dp_axis not in mesh.shape or mesh.shape[dp_axis] == 1:
-        return base_spec
+        return base_spec, None
     dp = mesh.shape[dp_axis]
     dims = list(base_spec) + [None] * (len(shape) - len(base_spec))
     for i, (d, s) in enumerate(zip(dims, shape)):
         if d is None and int(s) % dp == 0 and int(s) >= dp:
             dims[i] = dp_axis
-            return P(*dims)
-    return base_spec
+            return P(*dims), None
+    if not shape:
+        reason = "scalar state cannot shard over %s=%d" % (dp_axis, dp)
+    else:
+        reason = ("no free dim of %s divides %s=%d (zero-1 keeps %d "
+                  "full copies)" % (tuple(int(s) for s in shape),
+                                    dp_axis, dp, dp))
+    return base_spec, reason
+
+
+def zero1_spec(base_spec, shape, mesh, dp_axis="dp"):
+    """ZeRO-1: shard an optimizer-state tensor over the dp axis on its
+    first free, divisible dim (on top of any mp sharding the matching
+    parameter has).  GSPMD then reduce-scatters the gradient into the
+    shard-wise accumulator update and all-gathers the updated params —
+    all-reduce bandwidth, 1/dp optimizer-state memory.  See
+    `zero1_spec_reason` for the variant that reports why a tensor could
+    not shard."""
+    spec, _reason = zero1_spec_reason(base_spec, shape, mesh,
+                                      dp_axis=dp_axis)
+    return spec
 
 
 def shard_map_norep(fn, **kwargs):

@@ -17,10 +17,35 @@ from ..jit import FunctionalProgram, state_from_scope
 from ..obs import flight as obs_flight
 from ..obs import health as obs_health
 from ..obs import telemetry as obs_tele
+from ..utils import flags as _flags
 from .sharding import (param_spec, batch_spec, is_optimizer_state,
                        optimizer_state_names, zero1_spec)
 
-__all__ = ["make_parallel_step", "ParallelTrainer"]
+__all__ = ["make_parallel_step", "ParallelTrainer", "verify_sharding"]
+
+
+def verify_sharding(program, mesh, feed_names, fetch_names,
+                    feed_specs=None, zero_stage=0, dp_axis="dp",
+                    mp_axis="mp", origin="parallel_trainer",
+                    hbm_gb=None):
+    """Run the static SPMD analyzer over `program` against `mesh` and
+    raise ProgramVerificationError on any error-severity S0xx finding
+    (non-divisible shard, schedule hazard, budget overrun) — BEFORE
+    anything lowers or compiles.  The trust-boundary gate behind
+    FLAGS_verify_sharding; callers can also invoke it directly.
+    Returns the ShardingPlan for introspection."""
+    from ..analysis import shard as shard_analysis
+
+    plan = shard_analysis.analyze_sharding(
+        program, mesh, feed_names=list(feed_names),
+        feed_specs=feed_specs, fetches=list(fetch_names),
+        zero_stage=zero_stage, dp_axis=dp_axis, mp_axis=mp_axis,
+        hbm_gb=hbm_gb, publish=True, origin=origin,
+        # trainer feeds carry their real runtime shapes: a
+        # non-divisible static batch is a hard S002 here
+        concrete_feeds=True)
+    plan.report.raise_on_error()
+    return plan
 
 
 def make_parallel_step(program, feed_names, fetch_names, mesh,
@@ -42,8 +67,18 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
     feed_specs overrides the default dp batch sharding per feed name
     (e.g. {"tokens": P("dp", "sp")} lays the sequence dim over the sp
     axis for sequence-parallel programs).
+
+    With FLAGS_verify_sharding on, the static SPMD analyzer runs over
+    the program/mesh pair first (unless the caller already did —
+    ParallelTrainer.init verifies before running startup) and rejects
+    S0xx errors before any lowering.
     """
     if fp is None:
+        if program is not None and _flags.get_flag("verify_sharding"):
+            verify_sharding(program, mesh, feed_names, fetch_names,
+                            feed_specs=feed_specs,
+                            zero_stage=zero_stage, dp_axis=dp_axis,
+                            mp_axis=mp_axis, origin="parallel_step")
         fp = FunctionalProgram(program, feed_names, fetch_names)
 
     # exact accumulator names from the program's optimizer ops (the
@@ -114,9 +149,22 @@ class ParallelTrainer:
 
     def init(self, scope=None, executor=None):
         """Run the startup program (single device), then lay the state out
-        over the mesh per the sharding specs."""
+        over the mesh per the sharding specs.
+
+        With FLAGS_verify_sharding on, the static SPMD analyzer runs
+        FIRST — before the startup program executes, before any jit
+        trace — so a non-divisible shard or schedule hazard rejects
+        with op/var/spec identity instead of burning an XLA compile."""
         from ..fluid.executor import Executor, CPUPlace
         from ..core.scope import Scope
+
+        if _flags.get_flag("verify_sharding"):
+            verify_sharding(self.main_program, self.mesh,
+                            self.feed_names, self.fetch_names,
+                            feed_specs=self.feed_specs,
+                            zero_stage=self.zero_stage,
+                            dp_axis=self.dp_axis, mp_axis=self.mp_axis,
+                            origin="parallel_trainer")
 
         scope = scope or Scope()
         exe = executor or Executor(CPUPlace())
@@ -192,6 +240,19 @@ class ParallelTrainer:
 
     def fetch_state(self, name):
         return np.asarray(self.state[name])
+
+    def sharding_plan(self, hbm_gb=None):
+        """Introspection: the static SPMD analysis of this trainer's
+        program/mesh pair (specs, replication reasons, comm cost,
+        per-device peak-HBM estimate) WITHOUT raising — see
+        docs/ANALYSIS.md 'lint before you burn a pod slice'."""
+        from ..analysis import shard as shard_analysis
+
+        return shard_analysis.analyze_sharding(
+            self.main_program, self.mesh, feed_names=self.feed_names,
+            feed_specs=self.feed_specs, fetches=self.fetch_names,
+            zero_stage=self.zero_stage, dp_axis=self.dp_axis,
+            mp_axis=self.mp_axis, hbm_gb=hbm_gb, publish=False)
 
     # -- supervisor integration ---------------------------------------------
     def dump_state_to(self, scope):

@@ -109,6 +109,17 @@ DEFINE_flag("verify_program", False,
             "op's output meta through jax.eval_shape, a build-time "
             "cost that the surfaces opting into verification (tests, "
             "serving warmup, the proglint CLI) pay explicitly")
+DEFINE_flag("verify_sharding", False,
+            "run the paddle_tpu.analysis.shard SPMD analyzer at the "
+            "parallel trust boundaries BEFORE any lowering: "
+            "ParallelTrainer.init / make_parallel_step analyze the "
+            "program against the mesh (S0xx codes, docs/ANALYSIS.md), "
+            "and the pipeline/MoE schedule constructors check their "
+            "axis layouts.  Error-severity findings raise "
+            "ProgramVerificationError naming op index, var, and spec "
+            "instead of surfacing minutes later as an XLA GSPMD "
+            "error.  Default off: the multichip dryrun, tests, and "
+            "proglint --mesh opt in explicitly")
 DEFINE_flag("amp_bf16_act", True,
             "when amp_bf16 is on, keep activations bfloat16 between ops "
             "instead of casting every MXU output back to f32 — halves "

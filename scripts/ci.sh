@@ -34,11 +34,17 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
-echo "[ci] proglint selftest (clean program verifies, 7 seeded corruptions each report their diagnostic code, executor verify gate) ..."
-timeout 300 python -m paddle_tpu.tools.lint_cli --selftest
+echo "[ci] proglint selftest (verifier corruptions + sharding analyzer: lenet5/golden clean on 4 dryrun meshes, seeded S-code corruptions) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
 echo "[ci] proglint golden fixtures (checked-in IR must be well-formed, not just pinned) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --golden --quiet
+
+echo "[ci] proglint --mesh over the four dryrun mesh shapes (pinned IR must also SHARD clean) ..."
+for mesh in dp=4,mp=2 dp=2,mp=2,sp=2 pp=4,dp=2 dp=2,ep=4; do
+    timeout 300 python -m paddle_tpu.tools.lint_cli --golden --quiet \
+        --mesh "$mesh"
+done
 
 echo "[ci] driver entry points ..."
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \

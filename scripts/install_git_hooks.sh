@@ -18,7 +18,8 @@ python -m compileall -q paddle_tpu tests examples bench.py __graft_entry__.py
 make -C native -q || make -C native
 # the checked-in golden ProgramDescs must be well-formed IR, not just
 # byte-stable: proglint walks each fixture through the full verifier
-python -m paddle_tpu.tools.lint_cli --golden --quiet
+# AND the SPMD analyzer under the default dryrun mesh
+python -m paddle_tpu.tools.lint_cli --golden --quiet --mesh dp=4,mp=2
 python -m pytest tests/test_math_ops.py tests/test_fit_a_line.py -q
 EOF
 chmod +x "$hook"

@@ -28,8 +28,8 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[smoke] chaos selftest (injected I/O fault + preemption + nonfinite; auto-resume must match fault-free run) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
-echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate) ..."
-timeout 300 python -m paddle_tpu.tools.lint_cli --selftest
+echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate + sharding analyzer over the 4 dryrun meshes) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
 echo "[smoke] dryrun_multichip(8) ..."
 # Simulate the driver env exactly: JAX_PLATFORMS points at the real TPU

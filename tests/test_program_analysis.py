@@ -743,6 +743,140 @@ def test_lint_cli_model_dir(tmp_path, capsys):
 # report plumbing
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# liveness over while/sub-block programs (cross-block reads must pin
+# variables live in the parent; sub-block liveness seeds from closures)
+# ---------------------------------------------------------------------------
+
+def _build_while_program():
+    """A counter while-loop whose body reads a block-0 temp (closure)
+    and accumulates into a carried var; returns (main, loss, names)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1, 4], dtype="float32",
+                              append_batch_size=False)
+        # a block-0 temp read ONLY inside the while body: without the
+        # cross-block live seed this op would be a false D001
+        bridge = fluid.layers.scale(x=x, scale=2.0)
+        acc = fluid.layers.fill_constant(shape=[1, 4],
+                                         dtype="float32", value=0.0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                       value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                           value=3)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond, max_steps=8)
+        with w.block():
+            fluid.layers.sums(input=[acc, bridge], out=acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        loss = fluid.layers.mean(x=acc)
+    return main, loss, {"bridge": bridge.name, "acc": acc.name}
+
+
+def test_while_program_analyzes_clean():
+    main, loss, _names = _build_while_program()
+    rep = analysis.check_program(main, fetches=[loss.name],
+                                 publish=False)
+    assert rep.ok(), rep.format()
+    # L003 must not fire either: nothing draws RNG
+    assert not rep.has("L003"), rep.format()
+
+
+def test_while_crossblock_read_is_not_dead():
+    """The op computing a temp consumed only by the while body must
+    not be a D001, and the temp not a D002 — sub-block reads pin it."""
+    main, loss, names = _build_while_program()
+    rep = analysis.analyze_dataflow(main, fetches=[loss.name])
+    flagged = {d.var_name for d in rep.diagnostics
+               if d.code in ("D001", "D002")}
+    assert names["bridge"] not in flagged, rep.format()
+
+
+def test_while_subblock_liveness_seeds_from_closures():
+    from paddle_tpu.analysis.dataflow import (Liveness,
+                                              _block_sub_reads)
+
+    main, loss, names = _build_while_program()
+    desc = main.desc
+    sub_idx = next(i for i in range(len(desc.blocks)) if i > 0
+                   and desc.block(i).ops)
+    sub = desc.block(sub_idx)
+    # carried/closure names (read by block 0 after the loop) seed the
+    # final live set of the body
+    cross = _block_sub_reads(desc, sub_idx)
+    lv = Liveness(sub.ops, final_live=cross).analyze()
+    # the accumulator is written by the body AND read next iteration /
+    # after the loop: it must be live out of the body's last op
+    assert names["acc"] in lv.live_out[len(sub.ops) - 1]
+    # nothing the body carries may show up as releasable
+    released = {n for ns in lv.reuse_candidates().values() for n in ns}
+    assert names["acc"] not in released
+    assert names["bridge"] not in released
+
+
+def test_while_subblock_internal_temp_releases():
+    """A temp local to the while body (not carried, not a closure)
+    dies inside the body — the liveness the memory optimizer consumes
+    must release it for reuse."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1, 4], dtype="float32",
+                              append_batch_size=False)
+        acc = fluid.layers.fill_constant(shape=[1, 4],
+                                         dtype="float32", value=0.0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                       value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                           value=3)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond, max_steps=8)
+        with w.block():
+            t = fluid.layers.scale(x=x, scale=3.0)   # body-local temp
+            t2 = fluid.layers.scale(x=t, scale=0.5)  # t dies here
+            fluid.layers.sums(input=[acc, t2], out=acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        fluid.layers.mean(x=acc)
+
+    from paddle_tpu.analysis.dataflow import (Liveness,
+                                              _block_sub_reads)
+
+    desc = main.desc
+    sub_idx = next(i for i in range(len(desc.blocks)) if i > 0
+                   and desc.block(i).ops)
+    sub = desc.block(sub_idx)
+    lv = Liveness(sub.ops,
+                  final_live=_block_sub_reads(desc, sub_idx)).analyze()
+    released = {n for ns in lv.reuse_candidates().values() for n in ns}
+    assert t.name in released, (t.name, released)
+    assert acc.name not in released
+
+
+def test_memory_optimize_while_program_still_verifies():
+    """fluid.memory_optimize shares THE liveness engine; after buffer
+    reuse rewrites a while program, the result must still verify
+    clean and execute to the same value."""
+    main, loss, _names = _build_while_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        feed = {"x": np.ones((1, 4), np.float32)}
+        (before,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+    fluid.memory_optimize(main)
+    rep = analysis.check_program(main, fetches=[loss.name],
+                                 publish=False)
+    assert rep.ok(), rep.format()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(fluid.default_startup_program())
+        (after,) = exe.run(main, feed={"x": np.ones((1, 4),
+                                                    np.float32)},
+                           fetch_list=[loss.name])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after))
+
+
 def test_report_counters_published():
     from paddle_tpu.obs import registry as obs_registry
 

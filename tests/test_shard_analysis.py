@@ -1,0 +1,615 @@
+"""paddle_tpu.analysis.shard: the static SPMD/sharding analyzer and
+its wiring (S0xx codes, mesh validation, reasoned spec fallbacks, the
+comm cost model, the FLAGS_verify_sharding trainer gate, transpiler
+split validation).
+
+Negative tests seed real sharding mistakes and assert the STABLE
+diagnostic code (docs/ANALYSIS.md) — the same contract the proglint
+--mesh selftest and CI enforce."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import costmodel
+from paddle_tpu.parallel import (MeshConfig, make_mesh, parse_mesh_spec,
+                                 param_spec_reason, zero1_spec_reason)
+from paddle_tpu.utils import flags
+
+
+def _build_mlp(batch=None, width=1024):
+    """fc -> relu -> fc -> mean(+SGD) in a fresh Program pair; width
+    1024 makes the fc weights mp-shardable under the default rules."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if batch is None:
+            x = fluid.layers.data(name="x", shape=[width],
+                                  dtype="float32")
+        else:
+            x = fluid.layers.data(name="x", shape=[batch, width],
+                                  dtype="float32",
+                                  append_batch_size=False)
+        h = fluid.layers.fc(input=x, size=width, act="relu")
+        h2 = fluid.layers.fc(input=h, size=width)
+        loss = fluid.layers.mean(x=h2)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss.name
+
+
+# ---------------------------------------------------------------------------
+# mesh descriptions (satellite: errors must NAME the axes)
+# ---------------------------------------------------------------------------
+
+def test_parse_mesh_spec():
+    cfg = parse_mesh_spec("dp=4,mp=2")
+    assert dict(cfg.shape) == {"dp": 4, "mp": 2}
+    assert list(MeshConfig.parse("pp=4,dp=2").shape) == ["pp", "dp"]
+    with pytest.raises(ValueError, match="unknown axis"):
+        parse_mesh_spec("dp=4,zz=2")
+    with pytest.raises(ValueError, match="axis=size"):
+        parse_mesh_spec("dp4")
+    with pytest.raises(ValueError, match="named twice"):
+        parse_mesh_spec("dp=2,dp=4")
+
+
+def test_mesh_config_validate_names_axes():
+    with pytest.raises(ValueError) as err:
+        MeshConfig(dp=4, mp=3).validate(8)
+    assert "dp=4" in str(err.value) and "mp=3" in str(err.value)
+    # dp=None: the remaining devices must divide the other axes
+    with pytest.raises(ValueError) as err:
+        MeshConfig(mp=3).validate(8)
+    assert "mp=3" in str(err.value)
+    MeshConfig(dp=4, mp=2).validate(8)
+    MeshConfig(mp=2).validate(8)
+
+
+def test_make_mesh_error_names_axes():
+    with pytest.raises(ValueError) as err:
+        make_mesh(n_devices=8, dp=4, mp=3)
+    assert "dp=4" in str(err.value) and "mp=3" in str(err.value)
+    with pytest.raises(ValueError) as err:
+        make_mesh(n_devices=8, mp=3)
+    assert "mp=3" in str(err.value) and "8" in str(err.value)
+
+
+# ---------------------------------------------------------------------------
+# reasoned spec fallbacks (satellite: no more silent replication)
+# ---------------------------------------------------------------------------
+
+def test_param_spec_reason():
+    mesh = parse_mesh_spec("dp=4,mp=2")
+    spec, reason = param_spec_reason("w", (1024, 1024), mesh)
+    assert tuple(spec) == ("mp", None) and reason is None  # row table
+    spec, reason = param_spec_reason("w", (512, 1024), mesh)
+    assert tuple(spec) == (None, "mp") and reason is None
+    # deliberate policy: non-2D / no mp axis -> no reason
+    assert param_spec_reason("c", (64, 3, 3, 3), mesh)[1] is None
+    assert param_spec_reason("w", (1024, 1024),
+                             parse_mesh_spec("dp=8"))[1] is None
+    # forced fallbacks carry the why
+    _, r = param_spec_reason("w", (100, 200), mesh)
+    assert r and "min_shard_dim" in r
+    _, r = param_spec_reason("w", (513, 1023), mesh)
+    assert r and "not divisible" in r
+
+
+def test_zero1_spec_reason():
+    mesh = parse_mesh_spec("dp=4,mp=2")
+    spec, reason = zero1_spec_reason((), (1024,), mesh)
+    assert tuple(spec) == ("dp",) and reason is None
+    _, r = zero1_spec_reason((), (6,), mesh)
+    assert r and "dp=4" in r
+    _, r = zero1_spec_reason((), (), mesh)
+    assert r and "scalar" in r
+
+
+# ---------------------------------------------------------------------------
+# S0xx diagnostics
+# ---------------------------------------------------------------------------
+
+def test_s001_unmatched_rule():
+    main, _, loss = _build_mlp()
+    plan = analysis.analyze_sharding(
+        main, {"dp": 4, "mp": 2}, fetches=[loss],
+        rules=[("^no_such_param$", ())], publish=False)
+    diags = [d for d in plan.report.diagnostics if d.code == "S001"]
+    assert diags and all(d.severity == "warning" for d in diags)
+    assert "matched no partition rule" in diags[0].message
+
+
+def test_s001_heuristic_cites_reason():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[513], dtype="float32")
+        fluid.layers.fc(input=x, size=1023)  # 513x1023: near miss
+    plan = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     publish=False)
+    diags = [d for d in plan.report.diagnostics if d.code == "S001"
+             and d.var_name == "fc_0.w_0"]
+    assert diags and "not divisible" in diags[0].message
+    assert plan.param_reasons["fc_0.w_0"]
+
+
+def test_s002_concrete_feed_batch_is_error():
+    main, _, loss = _build_mlp(batch=6)
+    plan = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     fetches=[loss], publish=False,
+                                     concrete_feeds=True)
+    errs = [d for d in plan.report.errors if d.code == "S002"]
+    assert errs and errs[0].var_name == "x"
+    assert "dp=4" in errs[0].message
+
+
+def test_s002_pinned_feed_batch_is_advisory():
+    main, _, loss = _build_mlp(batch=6)
+    plan = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     fetches=[loss], publish=False)
+    assert plan.report.ok()
+    infos = [d for d in plan.report.by_severity("info")
+             if d.code == "S002"]
+    assert infos and "rebuild" in infos[0].message
+
+
+def test_s002_rule_forced_non_divisible_param():
+    main, _, loss = _build_mlp()
+    # a rule that row-shards a 1024-row weight over a 3-wide axis
+    plan = analysis.analyze_sharding(
+        main, {"dp": 2, "mp": 3}, fetches=[loss],
+        rules=[(r"\.w_0$", ("mp", None)), (".*", ())], publish=False)
+    errs = [d for d in plan.report.errors if d.code == "S002"]
+    assert errs, plan.report.format()
+    assert "mp=3" in errs[0].message
+
+
+def test_s004_unknown_axis_in_rule_or_feed_spec():
+    """A typo'd axis name in a partition rule / feed override must
+    NOT silently analyze as unsharded (factor 1)."""
+    main, _, loss = _build_mlp()
+    plan = analysis.analyze_sharding(
+        main, {"dp": 4, "mp": 2}, fetches=[loss],
+        rules=[(r"\.w_0$", ("tp", None)), (".*", ())], publish=False)
+    errs = [d for d in plan.report.errors if d.code == "S004"]
+    assert errs and "'tp'" in errs[0].message, plan.report.format()
+    plan = analysis.analyze_sharding(
+        main, {"dp": 4, "mp": 2}, fetches=[loss],
+        feed_specs={"x": ("data",)}, publish=False)
+    assert any(d.code == "S004" and d.var_name == "x"
+               for d in plan.report.errors), plan.report.format()
+
+
+def test_comm_pricing_follows_dtype():
+    """bf16 tensors price their collectives at 2 bytes/element, same
+    as the dtype-aware grad-sync path — rankings stay consistent."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[8, 16],
+                              dtype="bfloat16",
+                              append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[8, 16],
+                              dtype="bfloat16",
+                              append_batch_size=False)
+        fluid.layers.elementwise_add(x=a, y=b)
+    plan = analysis.analyze_sharding(
+        main, {"dp": 4, "mp": 2}, feed_specs={"b": ("mp",)},
+        publish=False)
+    ev = next(e for e in plan.comm.events
+              if e.collective == "allgather")
+    assert ev.payload_bytes == 8 * 16 * 2, ev.to_dict()
+
+
+def test_s003_conflicting_layouts():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[8, 16], dtype="float32",
+                              append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[8, 16], dtype="float32",
+                              append_batch_size=False)
+        fluid.layers.elementwise_add(x=a, y=b)
+    plan = analysis.analyze_sharding(
+        main, {"dp": 4, "mp": 2}, feed_specs={"b": ("mp",)},
+        publish=False)
+    diags = [d for d in plan.report.diagnostics if d.code == "S003"]
+    assert diags and diags[0].op_type == "elementwise_add"
+    # the implicit reshard is priced
+    assert any(ev.collective == "allgather"
+               for ev in plan.comm.events)
+
+
+def test_s004_pipeline_schedule():
+    rep = analysis.check_pipeline({"pp": 4, "dp": 2}, n_stages=3,
+                                  n_microbatches=8)
+    assert [d.code for d in rep.errors] == ["S004"]
+    assert "3 stages" in rep.errors[0].message
+    rep = analysis.check_pipeline({"pp": 4}, n_stages=4,
+                                  n_microbatches=2)
+    assert rep.ok() and rep.has("S004")  # bubble warning
+    rep = analysis.check_pipeline({"dp": 8}, n_stages=4,
+                                  n_microbatches=8)
+    assert not rep.ok() and "not a mesh axis" in rep.errors[0].message
+    rep = analysis.check_pipeline({"pp": 4}, n_stages=4,
+                                  n_microbatches=3, batch_size=8)
+    assert any("not divisible into 3 microbatches" in d.message
+               for d in rep.errors)
+    # degenerate pp=1 / zero microbatches must not crash
+    rep = analysis.check_pipeline({"pp": 1}, n_stages=1,
+                                  n_microbatches=0)
+    assert rep.ok()
+
+
+def test_s004_moe_schedule():
+    rep = analysis.check_moe({"dp": 2, "ep": 4}, n_experts=6)
+    assert not rep.ok() and "6 experts" in rep.errors[0].message
+    # guaranteed capacity overflow: factor 0.25 drops 3/4 of tokens
+    rep = analysis.check_moe({"dp": 2, "ep": 4}, n_experts=8,
+                             capacity_factor=0.25, tokens=1024)
+    assert rep.has("S004")
+    assert any("dropped EVERY step" in d.message
+               for d in rep.diagnostics)
+    # clean config
+    rep = analysis.check_moe({"dp": 2, "ep": 4}, n_experts=8,
+                             capacity_factor=2.0, tokens=1024)
+    assert rep.ok() and not rep.diagnostics
+
+
+def test_s004_ring_schedule():
+    rep = analysis.check_ring({"dp": 4, "mp": 2}, seq_len=32)
+    assert not rep.ok()
+    rep = analysis.check_ring({"sp": 2, "dp": 4}, seq_len=33)
+    assert rep.has("S002")
+    rep = analysis.check_ring({"sp": 2, "dp": 4}, seq_len=32,
+                              n_heads=3, mode="ulysses")
+    assert rep.has("S004")
+    assert analysis.check_ring({"sp": 2, "dp": 4}, seq_len=32,
+                               n_heads=4, mode="ulysses").ok()
+
+
+def test_s005_hbm_budget():
+    main, _, loss = _build_mlp()
+    plan = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     fetches=[loss], hbm_gb=1e-6,
+                                     publish=False)
+    errs = [d for d in plan.report.errors if d.code == "S005"]
+    assert errs and "budget" in errs[0].message
+    assert plan.peak_hbm_bytes > 0
+    bd = plan.hbm_breakdown
+    assert bd["params_bytes"] > 0 and bd["activation_peak_bytes"] > 0
+    # a sane budget passes
+    ok = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                   fetches=[loss], hbm_gb=16,
+                                   publish=False)
+    assert not ok.report.has("S005")
+
+
+def test_hbm_shrinks_with_mp():
+    main, _, loss = _build_mlp()
+    rep1 = analysis.analyze_sharding(main, {"dp": 8}, fetches=[loss],
+                                     publish=False)
+    rep2 = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     fetches=[loss], publish=False)
+    # mp shards the two 1024x1024 weights: params halve (roughly)
+    assert rep2.hbm_breakdown["params_bytes"] < \
+        rep1.hbm_breakdown["params_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# comm cost model
+# ---------------------------------------------------------------------------
+
+def test_collective_wire_bytes():
+    assert costmodel.collective_wire_bytes("allreduce", 1000, 4) == 1500
+    assert costmodel.collective_wire_bytes("allgather", 1000, 4) == 750
+    assert costmodel.collective_wire_bytes("allreduce", 1000, 1) == 0
+    with pytest.raises(ValueError):
+        costmodel.collective_wire_bytes("gossip", 1, 2)
+
+
+def test_comm_cost_dp_grad_sync():
+    main, _, loss = _build_mlp()
+    plan = analysis.analyze_sharding(main, {"dp": 8}, fetches=[loss],
+                                     publish=False)
+    sync = [ev for ev in plan.comm.events
+            if "grad sync" in ev.detail]
+    # 2 weights + 2 biases, all replicated under dp-only
+    assert len(sync) == 4
+    w = next(ev for ev in sync if "fc_0.w_0" in ev.detail)
+    # 1024*1024*4 bytes, ring all-reduce factor 2*(8-1)/8
+    assert w.wire_bytes == int(1024 * 1024 * 4 * 2 * 7 / 8)
+    assert plan.comm.totals()["allreduce"] > 0
+    assert plan.comm.step_seconds_floor() > 0
+
+
+def test_comm_cost_zero1_reduce_scatter():
+    main, _, loss = _build_mlp()
+    plan = analysis.analyze_sharding(main, {"dp": 8}, fetches=[loss],
+                                     zero_stage=1, publish=False)
+    colls = {ev.collective for ev in plan.comm.events}
+    assert "reducescatter" in colls and "allgather" in colls
+
+
+def test_comm_cost_published_to_registry():
+    from paddle_tpu.obs import registry as obs_registry
+
+    main, _, loss = _build_mlp()
+    analysis.analyze_sharding(main, {"dp": 8}, fetches=[loss],
+                              publish=True)
+    snap = {s["name"] for s in
+            obs_registry.get_registry().to_dict()["metrics"]}
+    assert "shard_comm_bytes_total" in snap
+    assert "shard_peak_hbm_bytes" in snap
+
+
+def test_batched_matmul_contraction_dim():
+    """matmul with ndim>2 operands: Y's contraction dim is -2, not the
+    batch dim — a dp-sharded batch dim on Y must not fake an S003."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[8, 16, 32],
+                              dtype="float32",
+                              append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[8, 32, 16],
+                              dtype="float32",
+                              append_batch_size=False)
+        fluid.layers.matmul(x=a, y=b)
+    plan = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     publish=False)
+    # both batch dims carry dp; contractions are unsharded: no S003,
+    # no partial-sum allreduce
+    assert not plan.report.has("S003"), plan.report.format()
+    assert not any("partial-sum" in ev.detail
+                   for ev in plan.comm.events)
+
+
+def test_mp_sharding_plan_and_matmul_partials():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1024], dtype="float32")
+        # 1024x1024 row-shardable table feeding a matmul: rows >= cols
+        # and >= min_shard_dim*mp -> P(mp, None), a sharded contraction
+        h = fluid.layers.fc(input=x, size=2048)
+        loss = fluid.layers.mean(x=h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    plan = analysis.analyze_sharding(main, {"dp": 4, "mp": 2},
+                                     fetches=[loss.name],
+                                     publish=False)
+    assert plan.sharded_params(), plan.param_reasons
+    assert plan.report.ok(), plan.report.format()
+
+
+# ---------------------------------------------------------------------------
+# trust-boundary wiring
+# ---------------------------------------------------------------------------
+
+class _MustNotRun:
+    """An 'executor' that fails the test if anything executes."""
+
+    def run(self, *a, **k):
+        raise AssertionError("startup executed: the sharding gate did "
+                             "not reject before lowering")
+
+
+def test_trainer_init_rejects_s002_before_any_lowering():
+    from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.parallel import ParallelTrainer
+
+    main, startup, loss = _build_mlp(batch=6)  # 6 % dp=4 != 0
+    mesh = make_mesh(n_devices=8, mp=2)
+    prev = flags.get_flag("verify_sharding")
+    flags.set_flag("verify_sharding", True)
+    try:
+        trainer = ParallelTrainer(main, startup, feed_names=["x"],
+                                  fetch_names=[loss], mesh=mesh)
+        with pytest.raises(analysis.ProgramVerificationError) as err:
+            trainer.init(executor=_MustNotRun())
+        assert "S002" in str(err.value)
+        assert "x" in str(err.value) and "dp=4" in str(err.value)
+    finally:
+        flags.set_flag("verify_sharding", prev)
+    # zero jit traces: nothing compiled, the executor never ran, no
+    # telemetry counter was ever created
+    snap = {s["name"] for s in
+            obs_registry.get_registry().to_dict()["metrics"]}
+    assert "executor_jit_traces_total" not in snap
+    assert trainer.state is None and trainer._step_fn is None
+
+
+def test_trainer_init_passes_clean_program_with_gate():
+    from paddle_tpu.parallel import ParallelTrainer
+
+    main, startup, loss = _build_mlp(batch=8, width=64)
+    mesh = make_mesh(n_devices=8, mp=2)
+    prev = flags.get_flag("verify_sharding")
+    flags.set_flag("verify_sharding", True)
+    try:
+        trainer = ParallelTrainer(main, startup, feed_names=["x"],
+                                  fetch_names=[loss], mesh=mesh).init()
+        (out,) = trainer.step(
+            {"x": np.random.RandomState(0)
+             .rand(8, 64).astype(np.float32)})
+        assert np.isfinite(np.asarray(out)).all()
+    finally:
+        flags.set_flag("verify_sharding", prev)
+
+
+def test_make_parallel_step_gate():
+    from paddle_tpu.jit import FunctionalProgram, state_from_scope
+    from paddle_tpu.parallel import make_parallel_step
+
+    main, startup, loss = _build_mlp(batch=6)
+    mesh = make_mesh(n_devices=8, mp=2)
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    fp = FunctionalProgram(main, ["x"], [loss])
+    state = state_from_scope(fp, scope)
+    prev = flags.get_flag("verify_sharding")
+    flags.set_flag("verify_sharding", True)
+    try:
+        with pytest.raises(analysis.ProgramVerificationError):
+            make_parallel_step(main, ["x"], [loss], mesh, state)
+    finally:
+        flags.set_flag("verify_sharding", prev)
+
+
+def test_pipeline_apply_gate():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import pipeline_apply, stack_stage_params
+
+    mesh = make_mesh(n_devices=8, pp=4, axes=("pp", "dp"))
+    stages = [{"w": jnp.eye(8, dtype=jnp.float32)} for _ in range(4)]
+    stacked = stack_stage_params(stages)
+    x = jnp.ones((8, 8), jnp.float32)
+    prev = flags.get_flag("verify_sharding")
+    flags.set_flag("verify_sharding", True)
+    try:
+        with pytest.raises(analysis.ProgramVerificationError) as err:
+            # 3 microbatches cannot tile the batch of 8
+            pipeline_apply(mesh, lambda p, h: h @ p["w"], stacked, x, 3)
+        assert "S004" in str(err.value)
+    finally:
+        flags.set_flag("verify_sharding", prev)
+
+
+def test_schedule_introspection_hooks():
+    from paddle_tpu.parallel import (expert_capacity, moe_axis_info,
+                                     pipeline_schedule_info,
+                                     sp_axis_info)
+
+    info = pipeline_schedule_info({"pp": 4, "dp": 2}, 8,
+                                  batch_size=32)
+    assert info["stages"] == 4 and info["ticks"] == 11
+    assert info["microbatch_size"] == 4
+    assert 0 < info["bubble_fraction"] < 1
+    assert expert_capacity(128, 8, 2.0) == 32
+    m = moe_axis_info({"dp": 2, "ep": 4}, 8, tokens=1024)
+    assert m["experts_per_device"] == 2 and m["capacity"] > 0
+    s = sp_axis_info({"sp": 2}, seq_len=32, n_heads=4, mode="ulysses")
+    assert s["local_seq"] == 16 and s["local_heads"] == 2
+
+
+def test_transpiler_validates_split_blocks():
+    from paddle_tpu.distributed.transpiler import DistributeTranspiler
+
+    def bad_split(var_list, pserver_count, **kw):
+        # drops the tail of every parameter
+        return [(v.name, 0, 0, max(int(np.prod(v.shape)) - 1, 1))
+                for v in var_list]
+
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y))
+    optimize_ops, params_grads = fluid.optimizer.SGD(
+        learning_rate=0.01).minimize(loss)
+    t = DistributeTranspiler()
+    with pytest.raises(ValueError, match="covers"):
+        t.transpile(optimize_ops=optimize_ops,
+                    params_grads=params_grads, trainer_id=0,
+                    trainers=2, pservers="127.0.0.1:6174",
+                    split_method=bad_split)
+
+    def dropping_split(var_list, pserver_count, **kw):
+        # silently forgets every parameter but the first
+        from paddle_tpu.distributed.transpiler import \
+            split_dense_variable
+
+        return split_dense_variable(var_list[:1], pserver_count)
+
+    with pytest.raises(ValueError, match="no pserver blocks"):
+        DistributeTranspiler().transpile(
+            optimize_ops=optimize_ops, params_grads=params_grads,
+            trainer_id=0, trainers=2, pservers="127.0.0.1:6174",
+            split_method=dropping_split)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance sweep: clean programs on all four dryrun mesh shapes
+# ---------------------------------------------------------------------------
+
+DRYRUN_MESHES = ["dp=4,mp=2", "dp=2,mp=2,sp=2", "pp=4,dp=2",
+                 "dp=2,ep=4"]
+
+
+@pytest.mark.parametrize("mesh_spec", DRYRUN_MESHES)
+def test_lenet5_clean_on_dryrun_meshes(mesh_spec):
+    from paddle_tpu.models.image import lenet5
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1],
+                                  dtype="int64")
+        probs = lenet5(img, class_dim=10)
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=probs, label=label))
+        fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.01, momentum=0.9).minimize(loss)
+    plan = analysis.analyze_sharding(main, parse_mesh_spec(mesh_spec),
+                                     fetches=[loss.name],
+                                     publish=False)
+    assert plan.report.ok(), plan.report.format()
+
+
+def test_lint_cli_golden_mesh(capsys):
+    from paddle_tpu.tools import lint_cli
+
+    rc = lint_cli.main(["--golden", "--quiet", "--mesh", "dp=4,mp=2"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "mesh={'dp': 4, 'mp': 2}" in out
+
+
+def test_lint_cli_mesh_publishes_each_finding_once(tmp_path, capsys):
+    """--mesh must not re-publish the already-counted base report:
+    every diagnostic lands in analysis_diagnostics_total exactly
+    once."""
+    import json
+    import os
+
+    from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.tools import lint_cli
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 8], dtype="float32",
+                              append_batch_size=False)
+        fluid.layers.scale(x=x, scale=2.0)
+        # a declared-but-unreferenced var: exactly one D002 info
+        main.global_block().create_var(name="orphan", shape=[1],
+                                       dtype="float32")
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    with open(os.path.join(model_dir, "__model__"), "w") as f:
+        json.dump({"program": main.desc.to_dict()}, f)
+    rc = lint_cli.main([model_dir, "--mesh", "dp=4", "--quiet"])
+    capsys.readouterr()
+    assert rc == 0
+    samples = [s for s in
+               obs_registry.get_registry().to_dict()["metrics"]
+               if s["name"] == "analysis_diagnostics_total"
+               and (s.get("labels") or {}).get("code") == "D002"]
+    assert samples and samples[0]["value"] == 1, samples
+
+
+def test_lint_cli_mesh_json(tmp_path, capsys):
+    import json
+    import os
+
+    from paddle_tpu.tools import lint_cli
+
+    main, _, loss = _build_mlp(width=64)
+    export = fluid.Program()
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    with open(os.path.join(model_dir, "__model__"), "w") as f:
+        json.dump({"program": main.desc.to_dict(),
+                   "fetch_names": [loss]}, f)
+    rc = lint_cli.main([model_dir, "--mesh", "dp=8", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["sharding"]["mesh"] == {"dp": 8}
+    assert doc["sharding"]["comm"]["totals"].get("allreduce", 0) > 0
+    assert doc["sharding"]["peak_hbm_bytes"] > 0
