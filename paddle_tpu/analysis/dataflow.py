@@ -41,7 +41,8 @@ from ..ops import registry as op_registry
 from .common import EMPTY, resolve_op_info
 from .diagnostics import Diagnostic, Report, Severity
 
-__all__ = ["Liveness", "analyze_block", "analyze_dataflow"]
+__all__ = ["Liveness", "analyze_block", "analyze_dataflow",
+           "dead_op_indices"]
 
 
 class Liveness:
@@ -209,36 +210,57 @@ def _referenced_names(desc):
     return referenced
 
 
+def dead_op_indices(desc, block_idx, fetches, name_sets=None):
+    """The D001 dead set for one block: op indices none of whose
+    outputs are ever read by a live op, fetched, persisted, or
+    referenced by another block.  Iterates to a fixpoint (killing an
+    op may kill its producers); effectful ops (host side effects,
+    sub-block holders, unregistered types) are never dead.
+
+    Shared by the D001 diagnostic below and the dead-op-elimination
+    rewrite pass (`paddle_tpu.compile.passes`), so the lint and the
+    transform can never disagree about what is removable.  Returns
+    (dead_index_set, Liveness).
+
+    The live seed takes the WHOLE cross-block read set, not just the
+    names this block declares: control-flow carry variables (a while
+    body writing `acc` declared in its parent) are referenced by the
+    parent op's slots but declared elsewhere — intersecting with
+    `bd.vars` would make the body's carried writes look dead."""
+    bd = desc.block(block_idx)
+    persistable = {n for n, vd in bd.vars.items() if vd.persistable}
+    sub_reads = _block_sub_reads(desc, block_idx, name_sets=name_sets)
+    live_seed = set(persistable) | sub_reads | set(fetches or ())
+    lv = Liveness(bd.ops, final_live=live_seed).analyze()
+    dead = set()
+    changed = True
+    while changed:
+        changed = False
+        needed = set(live_seed)
+        for i in reversed(range(len(lv.ops))):
+            if i in dead:
+                continue
+            if _is_effectful(lv.ops[i]) or (lv.defs[i] & needed):
+                needed |= lv.uses[i]
+            else:
+                dead.add(i)
+                changed = True
+    return dead, lv
+
+
 def analyze_block(desc, block_idx, report, fetches=None,
                   referenced=None, name_sets=None):
     """Dead-code + hazard diagnostics for one block."""
     bd = desc.block(block_idx)
-    persistable = {n for n, vd in bd.vars.items() if vd.persistable}
-    sub_reads = _block_sub_reads(desc, block_idx, name_sets=name_sets)
-
-    live_seed = set(persistable) | (sub_reads & set(bd.vars))
-    if fetches is not None:
-        live_seed |= set(fetches)
-    lv = Liveness(bd.ops, final_live=live_seed).analyze()
 
     # -- dead ops (only with a fetch set; see module docstring) -------------
     if fetches is not None:
         # without a fetch set every sink is live by assumption; with
-        # one, iterate to a fixpoint: an op is dead when nothing live
-        # reads its outputs, and killing it may kill its producers
-        dead = set()
-        changed = True
-        while changed:
-            changed = False
-            needed = set(live_seed)
-            for i in reversed(range(len(lv.ops))):
-                if i in dead:
-                    continue
-                if _is_effectful(lv.ops[i]) or (lv.defs[i] & needed):
-                    needed |= lv.uses[i]
-                else:
-                    dead.add(i)
-                    changed = True
+        # one, the shared fixpoint names the removable set (its
+        # Liveness doubles as this block's analysis — the hazard
+        # checks below only read def/use structure, not the seed)
+        dead, lv = dead_op_indices(desc, block_idx, fetches,
+                                   name_sets=name_sets)
         for i in sorted(dead):
             od = lv.ops[i]
             outs = sorted(lv.defs[i])
@@ -248,6 +270,13 @@ def analyze_block(desc, block_idx, report, fetches=None,
                 "persisted" % (", ".join(map(repr, outs)) or "(none)"),
                 block_idx=block_idx, op_index=i, op_type=od.type,
                 var_name=outs[0] if outs else None))
+    else:
+        persistable = {n for n, vd in bd.vars.items()
+                       if vd.persistable}
+        sub_reads = _block_sub_reads(desc, block_idx,
+                                     name_sets=name_sets)
+        lv = Liveness(bd.ops,
+                      final_live=persistable | sub_reads).analyze()
 
     # -- dead vars ----------------------------------------------------------
     if referenced is None:

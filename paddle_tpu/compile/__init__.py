@@ -1,0 +1,32 @@
+"""paddle_tpu.compile — compilation as a first-class, cached,
+pass-driven pipeline (the TVM direction; ROADMAP item 3).
+
+Two halves:
+
+  * `pcache` + `fingerprint` — a persistent on-disk executable cache.
+    The executor's jit-miss path AOT-compiles each segment, serializes
+    the lowered executable, and stores it keyed by a canonical
+    content-addressed Program fingerprint (IR + avals + dtype-policy
+    flags + pass-pipeline id + backend build).  A later process —
+    serving warmup, a supervisor auto-resume — reloads it with ZERO
+    new XLA compiles.  Gated by `FLAGS_compile_cache_dir`; off means
+    the jit call path is exactly the pre-cache behavior.
+  * `passes` — Program-level IR rewrite passes over the analysis
+    subsystem's def-use/liveness machinery: dead-op/dead-var
+    elimination, shape/fill constant folding, and pure-op CSE, run by
+    a `PassManager` that re-verifies the IR around every pass.  Gated
+    by `FLAGS_compile_passes`.
+
+Operator surface: `python -m paddle_tpu.tools.pcache_cli` ("pcc") for
+stats / prewarm / gc / --selftest.  docs/COMPILE_CACHE.md documents
+the cache-key anatomy, invalidation rules, and the ops runbook.
+"""
+
+from . import fingerprint
+from . import pcache
+from . import passes
+from .passes import PassManager, optimize_program
+from .pcache import PersistentCache
+
+__all__ = ["fingerprint", "pcache", "passes", "PassManager",
+           "optimize_program", "PersistentCache"]

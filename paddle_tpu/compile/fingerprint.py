@@ -1,0 +1,193 @@
+"""Canonical, content-addressed fingerprints for compiled artifacts.
+
+The persistent executable cache (`pcache`) can only be correct if its
+key captures EVERYTHING the lowered executable depends on.  This
+module owns that key:
+
+  * `canonical_desc(program)` — a canonical dict form of the Program
+    IR: vars sorted by name, op order preserved (it is semantic),
+    input/output slots and attrs sorted, BlockRefs and numpy scalars
+    coerced to plain JSON.  Two Programs built independently (even in
+    different processes) that describe the same computation produce
+    the same canonical form — the same property the analysis verifier
+    relies on when it re-derives metas from the desc.
+  * `program_fingerprint(...)` — sha256 over the canonical desc plus
+    the trace-time inputs that specialize the executable: feed/fetch
+    names, the dtype-policy flags (amp), the rewrite-pipeline id, and
+    an optional mesh/sharding description.
+  * `values_signature(...)` — a canonical string for the runtime aval
+    signature (shapes/dtypes/tree structure) of a segment's inputs;
+    jax re-specializes per signature, so the cache must too.
+  * `environment_fingerprint()` — jax/jaxlib versions, backend
+    platform, device kind and topology.  An executable serialized for
+    one backend build must never be offered to another.
+
+Fingerprints are hex sha256 strings; `combine(*parts)` folds any
+number of them (or raw strings) into one key.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+
+from ..core.desc import BlockRef
+
+__all__ = ["canonical_desc", "program_fingerprint", "values_signature",
+           "environment_fingerprint", "combine"]
+
+
+def _jsonable(v):
+    """Coerce an attr value to a canonical JSON-able form (BlockRefs
+    and the numpy scalars that sneak in from shape math included)."""
+    if isinstance(v, BlockRef):
+        return {"__block__": v.idx}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "backslashreplace")
+    return v
+
+
+def canonical_desc(program_or_desc):
+    """Canonical dict form of a Program / ProgramDesc (see module
+    docstring).  Op ORDER is preserved — it is part of the program's
+    meaning — while every unordered collection is sorted."""
+    desc = getattr(program_or_desc, "desc", program_or_desc)
+    blocks = []
+    for bd in desc.blocks:
+        ops = []
+        for od in bd.ops:
+            ops.append({
+                "type": od.type,
+                "inputs": {k: list(od.inputs[k])
+                           for k in sorted(od.inputs)},
+                "outputs": {k: list(od.outputs[k])
+                            for k in sorted(od.outputs)},
+                "attrs": {k: _jsonable(od.attrs[k])
+                          for k in sorted(od.attrs)},
+            })
+        variables = []
+        for name in sorted(bd.vars):
+            vd = bd.vars[name]
+            variables.append({
+                "name": vd.name, "type": vd.type, "dtype": vd.dtype,
+                "shape": (list(vd.shape) if vd.shape is not None
+                          else None),
+                "lod_level": vd.lod_level,
+                "persistable": bool(vd.persistable),
+            })
+        blocks.append({"idx": bd.idx, "parent_idx": bd.parent_idx,
+                       "vars": variables, "ops": ops})
+    return {"blocks": blocks}
+
+
+def _sha(text):
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def combine(*parts):
+    """Fold any number of strings/fingerprints into one key."""
+    return _sha("\x1f".join(str(p) for p in parts))
+
+
+def program_fingerprint(program, feeds=(), fetches=(), flag_items=None,
+                        pipeline_id="", mesh=None):
+    """Content fingerprint of a Program specialized by its trace-time
+    inputs.
+
+    flag_items: explicit (name, value) pairs of the process flags that
+        change what gets traced (the executor passes its dtype-policy
+        set); None means "no flag dependence".
+    pipeline_id: the rewrite PassManager's pipeline id — entries must
+        never alias across pass configs.
+    mesh: optional mesh/sharding description — a jax Mesh, a
+        {axis: size} dict, or any object with `shape` — folded in so
+        a re-partitioned program misses cleanly.
+    """
+    payload = {
+        "ir": canonical_desc(program),
+        "feeds": sorted(str(f) for f in feeds),
+        "fetches": [str(f) for f in fetches],
+        "flags": (sorted((str(k), _jsonable(v))
+                         for k, v in flag_items) if flag_items else []),
+        "pipeline": str(pipeline_id),
+        "mesh": _mesh_desc(mesh),
+    }
+    return _sha(json.dumps(payload, sort_keys=True))
+
+
+def _mesh_desc(mesh):
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", None)
+    if shape is not None and hasattr(shape, "items"):
+        return sorted((str(k), int(v)) for k, v in shape.items())
+    if hasattr(mesh, "items"):
+        return sorted((str(k), int(v)) for k, v in mesh.items())
+    return str(mesh)
+
+
+# ---------------------------------------------------------------------------
+# runtime signatures
+# ---------------------------------------------------------------------------
+
+def _value_sig(v):
+    # RaggedTensor / SelectedRows carry nested arrays; describe each
+    from ..core.ragged import RaggedTensor, SelectedRows
+
+    if isinstance(v, RaggedTensor):
+        return ("ragged", _value_sig(v.values),
+                tuple(_value_sig(np.asarray(rs)) for rs in v.row_splits))
+    if isinstance(v, SelectedRows):
+        return ("rows", _value_sig(v.values), _value_sig(v.rows))
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        return ("py", type(v).__name__, repr(v))
+    return ("t", tuple(int(s) for s in shape), str(dtype))
+
+
+def values_signature_key(named_values):
+    """Hashable signature tuple for a {name: value} dict (or
+    (name, value) pairs): names sorted, each value reduced to its
+    shape/dtype aval (nested container types included).  This is the
+    per-call specialization key — same program + same key means the
+    same executable.  A plain tuple (no string building) because the
+    executor computes it on every jitted-segment dispatch."""
+    items = (named_values.items() if hasattr(named_values, "items")
+             else named_values)
+    return tuple((str(n), _value_sig(v))
+                 for n, v in sorted(items, key=lambda kv: str(kv[0])))
+
+
+def values_signature(named_values):
+    """String form of `values_signature_key` — what the on-disk cache
+    key folds in (stable across processes)."""
+    return repr(values_signature_key(named_values))
+
+
+def environment_fingerprint():
+    """Fingerprint of the compile environment: jax/jaxlib versions,
+    backend platform, device kind and count.  Executables must never
+    travel across any of these."""
+    import jax
+    import jaxlib
+
+    try:
+        devs = jax.devices()
+        kind = devs[0].device_kind
+        count = len(devs)
+    except Exception:
+        kind, count = "unknown", 0
+    return combine("jax=%s" % jax.__version__,
+                   "jaxlib=%s" % jaxlib.__version__,
+                   "backend=%s" % jax.default_backend(),
+                   "device=%s" % kind, "n=%d" % count,
+                   "procs=%d" % jax.process_count())

@@ -1,0 +1,392 @@
+"""Persistent on-disk cache of serialized XLA executables.
+
+Every process restart re-burns minutes of XLA compiles: serving warmup
+recompiles every bucket, a supervisor auto-resume pays full recompile
+before its first post-preemption step.  This cache makes the compile a
+once-per-content cost: the executor's jit-miss path AOT-compiles the
+segment (`fn.lower(...).compile()`), serializes the lowered executable
+(`jax.experimental.serialize_executable`), and stores it keyed by the
+canonical Program fingerprint (`fingerprint.py`).  A later process —
+same program content, same avals, same backend build — deserializes
+and runs with ZERO new XLA compiles.
+
+Durability discipline (same as fluid/checkpoint.py):
+
+  * atomic writes — mkstemp in the entries dir, fsync, os.replace, dir
+    fsync; a kill mid-store can never leave a torn entry;
+  * CRC'd entries — every payload carries a crc32; a bit-rotted or
+    truncated file is detected on load;
+  * quarantine-not-crash — a corrupt or undeserializable entry is
+    MOVED to `<root>/quarantine/` and reported as a miss; the run
+    recompiles and re-stores, it never fails;
+  * LRU size cap — `FLAGS_compile_cache_max_bytes`; loads touch mtime,
+    stores evict oldest-used entries until the cache fits.
+
+Backends whose executables do not serialize (serialize_executable
+raises) get a "stub" entry recording that the content was compiled and
+how long it took — stats and eviction still work, loads report a miss.
+
+Metrics (obs registry): `compile_cache_{hits,misses,evictions,
+errors}_total`, `compile_cache_{load,compile}_seconds` histograms, and
+`compile_cache_saved_compile_seconds_total` (the sum of original
+compile durations served back as hits — the wall-clock the cache
+refunded).
+"""
+
+import io
+import json
+import logging
+import os
+import pickle
+import tempfile
+import threading
+import time
+import zlib
+
+from ..obs import registry as registry_mod
+from ..utils import flags
+
+__all__ = ["PersistentCache", "enabled", "get_cache", "reset",
+           "publish_stats"]
+
+_MAGIC = b"PTPC1\n"
+_SUFFIX = ".ptx"
+
+_log = logging.getLogger("paddle_tpu.compile.pcache")
+
+_lock = threading.Lock()
+_caches = {}  # root -> PersistentCache
+
+
+def _reg():
+    return registry_mod.get_registry()
+
+
+def _hits():
+    return _reg().counter("compile_cache_hits_total",
+                          "persistent executable cache loads served "
+                          "from disk")
+
+
+def _misses():
+    return _reg().counter("compile_cache_misses_total",
+                          "persistent executable cache lookups that "
+                          "had to compile")
+
+
+def _evictions():
+    return _reg().counter("compile_cache_evictions_total",
+                          "entries evicted by the LRU size cap")
+
+
+def _errors(kind):
+    return _reg().counter("compile_cache_errors_total",
+                          "corrupt/unserializable/undeserializable "
+                          "cache entries, by kind",
+                          labelnames=("kind",)).labels(kind=kind)
+
+
+def _saved():
+    return _reg().counter("compile_cache_saved_compile_seconds_total",
+                          "sum of original compile durations served "
+                          "back as cache hits")
+
+
+def enabled():
+    return bool(flags.get_flag("compile_cache_dir"))
+
+
+def get_cache(root=None):
+    """Process-wide cache for `root` (default: the flag dir); one
+    instance per directory."""
+    root = root or flags.get_flag("compile_cache_dir")
+    if not root:
+        return None
+    root = os.path.abspath(root)
+    with _lock:
+        cache = _caches.get(root)
+        if cache is None:
+            cache = PersistentCache(root)
+            _caches[root] = cache
+        return cache
+
+
+def reset():
+    """Drop all cache instances (tests; the on-disk state stays)."""
+    with _lock:
+        _caches.clear()
+
+
+def publish_stats(root=None):
+    """Export the on-disk entry count / byte size as gauges (the
+    supervisor calls this on restore so a resumed run's /metrics says
+    what the cache held at resume time)."""
+    cache = get_cache(root)
+    if cache is None:
+        return None
+    stats = cache.stats()
+    _reg().gauge("compile_cache_entries",
+                 "entries in the persistent executable "
+                 "cache").set(stats["entries"])
+    _reg().gauge("compile_cache_bytes",
+                 "bytes held by the persistent executable "
+                 "cache").set(stats["bytes"])
+    return stats
+
+
+class PersistentCache:
+    """One cache root.  Layout::
+
+        <root>/entries/<key[:2]>/<key>.ptx
+        <root>/quarantine/<key>.ptx      (corrupt entries, kept for
+                                          post-mortems, cleared by gc)
+    """
+
+    def __init__(self, root, max_bytes=None):
+        self.root = os.path.abspath(str(root))
+        self.entries_dir = os.path.join(self.root, "entries")
+        self.quarantine_dir = os.path.join(self.root, "quarantine")
+        self._max_bytes = max_bytes
+        self._io_lock = threading.Lock()
+        # running size estimate so put() doesn't re-walk the whole
+        # entries tree per store (a cold run stores one entry per
+        # segment); initialized from one walk on first use, kept
+        # current by put/evict, re-synced by every real evict()
+        self._approx_bytes = None
+        os.makedirs(self.entries_dir, exist_ok=True)
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+
+    @property
+    def max_bytes(self):
+        if self._max_bytes is not None:
+            return self._max_bytes
+        return int(flags.get_flag("compile_cache_max_bytes"))
+
+    # -- paths --------------------------------------------------------------
+    def _entry_path(self, key):
+        return os.path.join(self.entries_dir, key[:2], key + _SUFFIX)
+
+    def _iter_entries(self):
+        for sub in sorted(os.listdir(self.entries_dir)):
+            subdir = os.path.join(self.entries_dir, sub)
+            if not os.path.isdir(subdir):
+                continue
+            for fname in sorted(os.listdir(subdir)):
+                if fname.endswith(_SUFFIX):
+                    yield os.path.join(subdir, fname)
+
+    # -- load ---------------------------------------------------------------
+    def get(self, key, backend=None):
+        """The deserialized `jax.stages.Compiled` for `key`, or None
+        (miss).  Corrupt entries are quarantined, never raised."""
+        path = self._entry_path(key)
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            _misses().inc()
+            return None
+        header, payload = self._parse(path, raw)
+        if header is None:
+            _misses().inc()
+            return None
+        if header.get("kind") != "serialized":
+            # stub: the backend couldn't serialize this executable;
+            # the entry only records that the content compiles
+            _misses().inc()
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            loaded = se.deserialize_and_load(serialized, in_tree,
+                                             out_tree, backend=backend)
+        except Exception as exc:
+            _log.warning("quarantining undeserializable cache entry "
+                         "%s: %r", path, exc)
+            self._quarantine(path, "deserialize")
+            _misses().inc()
+            return None
+        try:
+            os.utime(path, None)  # LRU touch
+        except OSError:
+            pass
+        _hits().inc()
+        _saved().inc(float(header.get("compile_seconds", 0.0)))
+        _reg().histogram("compile_cache_load_seconds",
+                         help_text="wall time to load+deserialize a "
+                                   "cached executable") \
+              .observe(time.perf_counter() - t0)
+        return loaded
+
+    def _parse(self, path, raw):
+        """(header, payload) or (None, None) with the file quarantined
+        when anything about it is off."""
+        try:
+            if not raw.startswith(_MAGIC):
+                raise ValueError("bad magic")
+            head, sep, rest = raw[len(_MAGIC):].partition(b"\n")
+            if not sep:
+                raise ValueError("truncated header")
+            header = json.loads(head.decode("utf-8"))
+            payload = rest
+            if len(payload) != int(header.get("payload_len", -1)):
+                raise ValueError("payload length mismatch")
+            if zlib.crc32(payload) != int(header.get("crc", -1)):
+                raise ValueError("crc mismatch")
+            return header, payload
+        except Exception as exc:
+            _log.warning("quarantining corrupt cache entry %s: %r",
+                         path, exc)
+            self._quarantine(path, "corrupt")
+            return None, None
+
+    def _quarantine(self, path, kind):
+        _errors(kind).inc()
+        try:
+            dest = os.path.join(self.quarantine_dir,
+                                os.path.basename(path))
+            os.replace(path, dest)
+        except OSError:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- store --------------------------------------------------------------
+    def put(self, key, compiled, compile_seconds=0.0, meta=None):
+        """Serialize `compiled` (a jax.stages.Compiled) under `key`.
+        Returns the entry kind stored: "serialized", or "stub" when
+        the backend does not support executable serialization."""
+        kind = "serialized"
+        payload = b""
+        try:
+            from jax.experimental import serialize_executable as se
+
+            serialized, in_tree, out_tree = se.serialize(compiled)
+            payload = pickle.dumps((serialized, in_tree, out_tree))
+        except Exception as exc:
+            # e.g. "Compilation does not support serialization" on
+            # backends without PjRt executable serialization: store a
+            # stub so stats still see the content, loads stay misses
+            _errors("serialize").inc()
+            _log.info("executable for %s does not serialize (%r); "
+                      "storing stub entry", key[:12], exc)
+            kind = "stub"
+        header = {
+            "key": key, "kind": kind, "crc": zlib.crc32(payload),
+            "payload_len": len(payload),
+            "compile_seconds": round(float(compile_seconds), 6),
+            "created": time.time(), "meta": meta or {},
+        }
+        blob = io.BytesIO()
+        blob.write(_MAGIC)
+        blob.write(json.dumps(header, sort_keys=True).encode("utf-8"))
+        blob.write(b"\n")
+        blob.write(payload)
+        data = blob.getvalue()
+        self._atomic_write(self._entry_path(key), data)
+        _reg().histogram("compile_cache_compile_seconds",
+                         help_text="wall time of the AOT compiles the "
+                                   "cache stored") \
+              .observe(float(compile_seconds))
+        # size-cap check against the running estimate; the real
+        # (walking) evict only runs when the estimate crosses the cap
+        if self._approx_bytes is None:
+            self._approx_bytes = sum(
+                os.stat(p).st_size for p in self._iter_entries())
+        else:
+            self._approx_bytes += len(data)
+        cap = self.max_bytes
+        if cap > 0 and self._approx_bytes > cap:
+            self.evict()
+        return kind
+
+    def _atomic_write(self, path, data):
+        """mkstemp + fsync + rename + dir fsync (the checkpoint
+        discipline: a kill mid-write never leaves a torn entry)."""
+        d = os.path.dirname(path)
+        os.makedirs(d, exist_ok=True)
+        with self._io_lock:
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            try:
+                dfd = os.open(d, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+
+    # -- maintenance --------------------------------------------------------
+    def evict(self, max_bytes=None):
+        """Drop oldest-used entries until the cache fits the size cap.
+        Returns the number evicted."""
+        cap = self.max_bytes if max_bytes is None else int(max_bytes)
+        if cap <= 0:
+            return 0
+        entries = []
+        total = 0
+        for path in self._iter_entries():
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, path))
+            total += st.st_size
+        evicted = 0
+        for _, size, path in sorted(entries):
+            if total <= cap:
+                break
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+            _evictions().inc()
+            _log.debug("evicted cache entry %s (%d bytes)", path, size)
+        self._approx_bytes = total  # re-sync the put() estimate
+        return evicted
+
+    def gc(self, max_bytes=None, clear_quarantine=True):
+        """Operator entry point (`pcc gc`): enforce the size cap and
+        (by default) clear the quarantine.  Returns a summary dict."""
+        evicted = self.evict(max_bytes=max_bytes)
+        cleared = 0
+        if clear_quarantine:
+            for fname in os.listdir(self.quarantine_dir):
+                try:
+                    os.remove(os.path.join(self.quarantine_dir, fname))
+                    cleared += 1
+                except OSError:
+                    pass
+        return {"evicted": evicted, "quarantine_cleared": cleared,
+                **self.stats()}
+
+    def stats(self):
+        entries = nbytes = 0
+        for path in self._iter_entries():
+            try:
+                nbytes += os.stat(path).st_size
+            except OSError:
+                continue
+            entries += 1
+        quarantined = len([f for f in os.listdir(self.quarantine_dir)
+                           if f.endswith(_SUFFIX)])
+        return {"root": self.root, "entries": entries, "bytes": nbytes,
+                "quarantined": quarantined,
+                "max_bytes": self.max_bytes}

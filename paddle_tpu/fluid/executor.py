@@ -29,6 +29,7 @@ the jitted step, and `locate_nonfinite(program, feed)` replays a bad
 step eagerly to name the first offending op (docs/OBSERVABILITY.md).
 """
 
+import logging
 import time
 
 import numpy as np
@@ -48,6 +49,8 @@ from ..resilience import faults as faults_mod
 from ..utils import flags
 from . import framework
 from . import profiler as profiler_mod
+
+_log = logging.getLogger("paddle_tpu.executor")
 
 
 class NonfiniteError(FloatingPointError):
@@ -322,6 +325,11 @@ class _CompiledProgram:
         block_desc = program.desc.block(block_idx)
         self.segments = _segment_block(block_desc.ops)
         self._jit_cache = {}
+        # persistent executable cache (FLAGS_compile_cache_dir): the
+        # program-level fingerprint is computed lazily on the first
+        # jit miss and combined per segment+signature (see
+        # _aot_acquire); None until then
+        self._pcache_base_fp = None
         self._plan = self._analyze()
 
     # -- data-flow analysis -------------------------------------------------
@@ -479,15 +487,74 @@ class _CompiledProgram:
             jitted = {
                 "fn": jax.jit(segment_fn, donate_argnums=(0,)),
                 "mutated": mutated,
+                # per-signature AOT executables from the persistent
+                # cache (False = permanent fallback to the jit path
+                # for that signature)
+                "aot": {},
             }
             self._jit_cache[i] = jitted
 
         mutated = jitted["mutated"]
         mut_ins = {n: v for n, v in in_vals.items() if n in mutated}
         ro_ins = {n: v for n, v in in_vals.items() if n not in mutated}
-        size_fn = getattr(jitted["fn"], "_cache_size", lambda: None)
         profiled = profiler_mod.is_enabled()
         tracing = obs_trace.is_enabled()
+
+        # persistent executable cache (FLAGS_compile_cache_dir): serve
+        # this (segment, signature) from an AOT executable — loaded
+        # from disk (zero XLA compiles) or compiled once and stored —
+        # instead of the jit call path.  Disabled, this whole branch
+        # is one flag read.
+        if flags.get_flag("compile_cache_dir"):
+            from ..compile import fingerprint as fp_mod
+
+            # hashable tuple, not a string: this runs on every
+            # dispatch — the repr lands in the disk key only on miss
+            sig = fp_mod.values_signature_key(
+                list(mut_ins.items()) + list(ro_ins.items())
+                + [("@rng", rng_state)])
+            aot = jitted["aot"].get(sig)
+            if aot is None:
+                aot = self._aot_acquire(i, seg, jitted,
+                                        (mut_ins, ro_ins, rng_state),
+                                        sig)
+                jitted["aot"][sig] = aot if aot is not None else False
+            if aot not in (None, False):
+                label = self._segment_label(i, seg)
+                try:
+                    if not (profiled or tracing):
+                        return aot(mut_ins, ro_ins, rng_state)
+                    t0 = time.perf_counter()
+                    outs, rng = aot(mut_ins, ro_ins, rng_state)
+                    jax.block_until_ready((outs, rng))
+                    dt = time.perf_counter() - t0
+                    if tracing:
+                        obs_trace.emit_span("executor/" + label, t0,
+                                            dt, cat="executor",
+                                            args={"pcache": True})
+                    if profiled:
+                        profiler_mod.record(label, dt)
+                    return outs, rng
+                except Exception as exc:
+                    # signature drift / backend mismatch: quarantine
+                    # THIS signature to the jit path and keep running
+                    # — the cache must never be the reason a step
+                    # fails.  Exception: a failure AFTER dispatch may
+                    # already have donated (deleted) the mutable
+                    # inputs; re-running on dead buffers would only
+                    # mask the real error, so it propagates.
+                    from ..compile import pcache as pcache_mod
+
+                    pcache_mod._errors("execute").inc()
+                    jitted["aot"][sig] = False
+                    if any(getattr(v, "is_deleted", lambda: False)()
+                           for v in mut_ins.values()):
+                        raise
+                    _log.warning("pcache executable for %s failed "
+                                 "(%r); falling back to jit path",
+                                 label, exc)
+
+        size_fn = getattr(jitted["fn"], "_cache_size", lambda: None)
         if not (profiled or tracing):
             # hot path: dispatch async; compile detection stays on (a
             # retrace is the single costliest event, telemetry must see
@@ -533,6 +600,80 @@ class _CompiledProgram:
                                    (mut_ins, ro_ins, rng_state))
         return outs, rng
 
+    def _pcache_base(self):
+        """Program-level fingerprint base for the persistent cache:
+        canonical IR + feed/fetch names + the dtype-policy flags that
+        specialize the trace + the rewrite-pipeline id + the backend
+        build.  Computed once per _CompiledProgram."""
+        if self._pcache_base_fp is None:
+            from ..compile import fingerprint as fp_mod
+            from ..compile import passes as passes_mod
+
+            prog_fp = fp_mod.program_fingerprint(
+                self.program, feeds=self.feed_names,
+                fetches=self.fetch_names,
+                flag_items=[(k, flags.get_flag(k)) for k in
+                            ("amp_bf16", "amp_bf16_act",
+                             "bn_shifted_stats")],
+                pipeline_id=passes_mod.pipeline_id(
+                    flags.get_flag("compile_passes")))
+            self._pcache_base_fp = fp_mod.combine(
+                prog_fp, fp_mod.environment_fingerprint())
+        return self._pcache_base_fp
+
+    def _aot_acquire(self, i, seg, jitted, args, sig):
+        """Load the (segment, signature) executable from the
+        persistent cache, or AOT-compile + store it.  Returns a
+        callable `jax.stages.Compiled`, or None when the cache is
+        unusable (the caller falls back to the jit path).  Only a real
+        XLA compile counts as a jit trace — a disk hit is the whole
+        point: zero new compiles."""
+        from ..compile import fingerprint as fp_mod
+        from ..compile import pcache as pcache_mod
+
+        label = self._segment_label(i, seg)
+        try:
+            cache = pcache_mod.get_cache()
+            if cache is None:
+                return None
+            key = fp_mod.combine(self._pcache_base(), "seg%d" % i,
+                                 ",".join(seg["outputs"]),
+                                 ",".join(jitted["mutated"]),
+                                 repr(sig))
+            loaded = cache.get(key)
+            if loaded is not None:
+                obs_trace.instant("pcache_hit", cat="compile",
+                                  segment=label)
+                if flags.get_flag("xla_cost_attribution") \
+                        or obs_health.attribution_forced():
+                    # attribution rides the loaded artifact — free on
+                    # a hit (no recompile; see _capture_xla_cost)
+                    obs_health.publish_compile_stats(label, loaded)
+                return loaded
+            t0 = time.perf_counter()
+            compiled = jitted["fn"].lower(*args).compile()
+            dt = time.perf_counter() - t0
+            # this is a real XLA compile: telemetry must see it (the
+            # warm-restart contract is asserted on this counter)
+            obs_tele.on_jit_trace(label)
+            cache.put(key, compiled, compile_seconds=dt,
+                      meta={"segment": label,
+                            "ops": len(seg["ops"])})
+            if flags.get_flag("xla_cost_attribution") \
+                    or obs_health.attribution_forced():
+                # satellite fix: the AOT artifact is at hand — no
+                # second lower().compile() for attribution
+                obs_health.publish_compile_stats(label, compiled)
+            return compiled
+        except Exception as exc:
+            _log.warning("persistent compile cache unusable for %s "
+                         "(%r); using jit path", label, exc)
+            try:
+                pcache_mod._errors("acquire").inc()
+            except Exception:
+                pass
+            return None
+
     @staticmethod
     def _capture_xla_cost(fn, label, args):
         """Best-effort per-segment memory/FLOP attribution at jit-build
@@ -543,8 +684,12 @@ class _CompiledProgram:
         this re-runs the XLA compile — roughly doubling a segment's
         first-build cost — which is why the flag defaults off and only
         startup-budget surfaces (serving warmup, bench legs that can
-        afford it) enable it.  Runtimes that expose neither analysis
-        are skipped silently."""
+        afford it) enable it.  With the persistent executable cache on
+        (FLAGS_compile_cache_dir), segments take the AOT path in
+        _aot_acquire and attribution is published from the SAME
+        lowered artifact — free on both a compile and a disk hit —
+        so this double-compile only remains on the plain jit path.
+        Runtimes that expose neither analysis are skipped silently."""
         if not (flags.get_flag("xla_cost_attribution")
                 or obs_health.attribution_forced()):
             return
@@ -631,13 +776,15 @@ class Executor:
             for name, val in feed.items():
                 feed_env[name] = self._prepare_feed(block0, name, val)
 
-            # dtype policy is trace-time state: a flipped amp flag must
-            # not reuse executables traced under the old policy
+            # dtype policy and the rewrite pipeline are trace-time
+            # state: a flipped amp flag (or pass config) must not
+            # reuse executables built under the old policy
             key = (program._cache_token, program.version, 0,
                    tuple(sorted(feed_env.keys())), tuple(fetch_names),
                    flags.get_flag("amp_bf16"),
                    flags.get_flag("amp_bf16_act"),
-                   flags.get_flag("bn_shifted_stats"))
+                   flags.get_flag("bn_shifted_stats"),
+                   flags.get_flag("compile_passes"))
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 # verify-before-first-compile (FLAGS_verify_program):
@@ -646,13 +793,33 @@ class Executor:
                 # layers down as an XLA trace error
                 if flags.get_flag("verify_program"):
                     self._verify_program(program, fetch_names)
-                compiled = _CompiledProgram(self, program, 0,
+                # FLAGS_compile_passes: rewrite a CLONE through the
+                # verified pass pipeline (dce/fold/cse/dve) before
+                # segmentation; the original program (and the cache
+                # key above) are untouched
+                program_to_compile = program
+                spec = flags.get_flag("compile_passes")
+                if spec:
+                    from ..compile import passes as passes_mod
+
+                    program_to_compile, _ = passes_mod.optimize_program(
+                        program, spec, fetches=list(fetch_names))
+                compiled = _CompiledProgram(self, program_to_compile, 0,
                                             sorted(feed_env.keys()),
                                             fetch_names)
                 if use_program_cache:
                     self._cache[key] = compiled
                     while len(self._cache) > self._CACHE_MAX:
-                        self._cache.popitem(last=False)
+                        ekey, _ = self._cache.popitem(last=False)
+                        # LRU eviction was silent: a hot serving mix
+                        # thrashing the program cache looked like
+                        # random recompiles.  Count it and name the
+                        # victim.
+                        obs_tele.on_program_cache_evict()
+                        _log.debug(
+                            "evicted program cache entry: token=%s "
+                            "version=%s feeds=%s fetches=%s",
+                            ekey[0], ekey[1], ekey[3], ekey[4])
             elif use_program_cache:
                 self._cache.move_to_end(key)
 

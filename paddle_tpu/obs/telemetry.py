@@ -25,8 +25,9 @@ from . import registry as registry_mod
 from . import trace as trace_mod
 
 __all__ = ["on_executor_run", "on_jit_trace", "on_transfer",
-           "jit_trace_count", "transfer_bytes", "step", "set_gauge",
-           "snapshot", "snapshot_delta", "snapshot_and_delta"]
+           "on_program_cache_evict", "jit_trace_count",
+           "transfer_bytes", "step", "set_gauge", "snapshot",
+           "snapshot_delta", "snapshot_and_delta"]
 
 # histogram bounds for step wall time: sub-ms tiny CPU steps up to
 # multi-second compile-included first steps
@@ -62,6 +63,16 @@ def jit_trace_count():
     return _reg().counter("executor_jit_traces_total",
                           "XLA trace/compile events detected across "
                           "jitted segments").value
+
+
+def on_program_cache_evict():
+    """The executor's program-level LRU cache dropped an entry — the
+    next run of that program pays a full replan (and, unbucketed, a
+    retrace).  Silent before; a thrashing serving mix looked like
+    random recompiles."""
+    _reg().counter("executor_program_cache_evictions_total",
+                   "compiled-program entries evicted from the "
+                   "executor's LRU cache").inc()
 
 
 def on_transfer(direction, nbytes):

@@ -135,6 +135,7 @@ class InferenceEngine:
         self.metrics = metrics
         self._exe = executor_mod.Executor(self.place)
         self._lock = threading.Lock()
+        self.last_warmup_stats = None  # set by warmup()
         # feed_meta: the export-time metadata dict from
         # save_inference_model (dtype as a numpy name string); absent
         # entries fall back to the program's var descs
@@ -349,7 +350,14 @@ class InferenceEngine:
         feeds, so no dense in-bucket request pays an XLA trace (ragged
         feeds warm only each batch bucket's smallest token/seqlen
         shape — see the module docstring).  Returns the number of
-        buckets warmed."""
+        buckets warmed.
+
+        With the persistent executable cache on
+        (FLAGS_compile_cache_dir), a warmup after a restart serves
+        each bucket's executables straight from disk — zero fresh XLA
+        compiles (docs/COMPILE_CACHE.md measures the cold-vs-warm
+        gap).  `last_warmup_stats` records what this warmup actually
+        did: buckets, seconds, fresh compiles, and disk hits."""
         # deploy-time static analysis FIRST — it must run even when
         # bucketing (and thus warmup compiling) is disabled: the
         # engine serves a program it did not build (a
@@ -391,6 +399,10 @@ class InferenceEngine:
                             max_delay=1.0, name="serving_warmup")
         saved_metrics, self.metrics = self.metrics, None
         warmed = 0
+        from ..obs import telemetry as obs_tele
+
+        snap_before = obs_tele.snapshot()
+        t0 = time.perf_counter()
         try:
             with obs_health.force_attribution():
                 for bucket in self.config.batch_buckets:
@@ -400,4 +412,22 @@ class InferenceEngine:
                     warmed += 1
         finally:
             self.metrics = saved_metrics
+        # what this warmup cost and where the executables came from:
+        # fresh XLA compiles vs persistent-cache disk hits (the
+        # cold-vs-warm evidence for docs/COMPILE_CACHE.md)
+        delta = obs_tele.snapshot_delta(snap_before)
+        self.last_warmup_stats = {
+            "buckets": warmed,
+            "seconds": round(time.perf_counter() - t0, 3),
+            "jit_compiles": delta.get("executor_jit_traces_total", 0),
+            "pcache_hits": delta.get("compile_cache_hits_total", 0),
+            "pcache_misses": delta.get("compile_cache_misses_total",
+                                       0),
+        }
+        from ..obs import registry as registry_mod
+
+        registry_mod.get_registry().gauge(
+            "serving_warmup_seconds",
+            "wall time of the most recent engine warmup") \
+            .set(self.last_warmup_stats["seconds"])
         return warmed

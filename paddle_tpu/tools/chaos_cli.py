@@ -181,6 +181,84 @@ def _supervised_run(args, chaos, ckpt_dir):
     return summary, losses, _final_params(sgd), fired
 
 
+def _warm_cache_resume_leg(args, workdir):
+    """The compile-cache resilience contract: a supervised run that is
+    SIGTERM-preempted with the persistent executable cache enabled,
+    then 'restarted' (fresh programs, fresh executor, fresh scope —
+    everything a real process restart clears), must resume and finish
+    with ZERO new XLA compiles — `executor_jit_traces_total` is the
+    ground truth (docs/COMPILE_CACHE.md)."""
+    from paddle_tpu.compile import pcache
+    from paddle_tpu.obs import telemetry as obs_tele
+    from paddle_tpu.reader import host_prefetch
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.supervisor import (Preempted,
+                                                  TrainingSupervisor)
+    from paddle_tpu.utils import flags
+
+    cache_dir = os.path.join(workdir, "pcache")
+    ckpt_dir = os.path.join(workdir, "warm")
+    flags.set_flag("compile_cache_dir", cache_dir)
+    pcache.reset()
+    try:
+        # phase 1: cold run, killed by a real SIGTERM mid-epoch
+        # (on_preempt="raise" — the production mode: the process
+        # exits on the urgent checkpoint and is rescheduled)
+        _fresh_workspace()
+        sgd = _build_mnist_mlp()
+        batches = _make_batches(args)
+
+        def reader():
+            for b in batches:
+                yield b
+
+        faults.enable(seed=args.seed)
+        faults.inject("supervisor/step", "preempt",
+                      after=max(2, args.steps // 2), times=1)
+        preempted = False
+        try:
+            TrainingSupervisor(
+                ckpt_dir, program=sgd._main_program,
+                steps_per_checkpoint=args.ckpt_every,
+                max_restarts=args.max_restarts,
+                on_preempt="raise").run(
+                sgd.step_runner(feeding={"img": 0, "label": 1}),
+                host_prefetch(reader, depth=2),
+                num_epochs=args.epochs)
+        except Preempted:
+            preempted = True
+        finally:
+            faults.disable()
+        assert preempted, "the preemption fault never fired"
+
+        # phase 2: the restart.  Everything in-process is rebuilt
+        # from scratch; only the checkpoint dir and the on-disk
+        # executable cache survive — exactly a rescheduled process.
+        _fresh_workspace()
+        pcache.reset()
+        sgd = _build_mnist_mlp()
+        traces_before = obs_tele.jit_trace_count()
+        summary = TrainingSupervisor(
+            ckpt_dir, program=sgd._main_program,
+            steps_per_checkpoint=args.ckpt_every,
+            max_restarts=args.max_restarts).run(
+            sgd.step_runner(feeding={"img": 0, "label": 1}),
+            host_prefetch(reader, depth=2), num_epochs=args.epochs)
+        new_compiles = obs_tele.jit_trace_count() - traces_before
+        assert new_compiles == 0, \
+            "post-SIGTERM restart performed %d fresh XLA compile(s); " \
+            "the persistent cache missed" % new_compiles
+        snap = obs_tele.snapshot()
+        assert snap.get("compile_cache_hits_total", 0) > 0, \
+            "restart never touched the executable cache: %s" % {
+                k: v for k, v in snap.items()
+                if k.startswith("compile_cache")}
+        return summary, new_compiles
+    finally:
+        flags.set_flag("compile_cache_dir", "")
+        pcache.reset()
+
+
 def selftest(args):
     import numpy as np
 
@@ -228,13 +306,22 @@ def selftest(args):
             clean_params[a], chaos_params[b],
             err_msg="final params diverged: %s vs %s" % (a, b))
 
+    # warm-cache resume: a preempted run restarted from disk must
+    # replay with zero new XLA compiles (persistent executable cache)
+    warm_sum, warm_compiles = _warm_cache_resume_leg(args, workdir)
+    assert warm_sum["steps"] == clean_sum["steps"], (warm_sum,
+                                                     clean_sum)
+
     print("[chaos] selftest green: %d faults fired %s, %d supervisor "
           "restart(s), final params and %d-step loss trajectory "
-          "IDENTICAL to the fault-free run (ckpts under %s)"
+          "IDENTICAL to the fault-free run; post-SIGTERM warm-cache "
+          "restart resumed with %d fresh XLA compile(s) (ckpts under "
+          "%s)"
           % (injected,
              sorted("%s:%s=%d" % (p, k, n)
                     for (p, k), n in fired.items()),
-             chaos_sum["restarts"], len(clean_loss), workdir),
+             chaos_sum["restarts"], len(clean_loss), warm_compiles,
+             workdir),
           flush=True)
     return 0
 

@@ -34,6 +34,9 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
+echo "[ci] pcc selftest (cold compile populates cache, restart reload = 0 XLA compiles, corrupt entry quarantined, rewrite passes bit-identical) ..."
+timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
+
 echo "[ci] proglint selftest (verifier corruptions + sharding analyzer: lenet5/golden clean on 4 dryrun meshes, seeded S-code corruptions) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 

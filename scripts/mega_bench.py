@@ -117,6 +117,21 @@ def _fresh_records(since):
             if r.get("measured_at", 0) >= since}
 
 
+def _compile_cache_summary(blob):
+    """The leg's persistent-executable-cache efficacy, distilled from
+    the registry delta: hits/misses and the compile wall-clock the
+    cache refunded (sum of original compile durations served back as
+    hits).  Stamped into every BENCH record so the perf trajectory
+    says whether a leg started warm."""
+    return {
+        "hits": blob.get("compile_cache_hits_total", 0),
+        "misses": blob.get("compile_cache_misses_total", 0),
+        "compile_seconds_saved": round(
+            blob.get("compile_cache_saved_compile_seconds_total",
+                     0.0), 3),
+    }
+
+
 def _attach_metrics(keys, blob):
     """Stamp each freshly-persisted BENCH record with the leg's
     observability blob — the leg's telemetry.snapshot_delta() over the
@@ -134,6 +149,7 @@ def _attach_metrics(keys, blob):
     for k in keys:
         if k in store:
             store[k]["metrics"] = blob
+            store[k]["compile_cache"] = _compile_cache_summary(blob)
             changed = True
     if not changed:
         return

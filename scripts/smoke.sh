@@ -28,6 +28,9 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[smoke] chaos selftest (injected I/O fault + preemption + nonfinite; auto-resume must match fault-free run) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
+echo "[smoke] pcc selftest (persistent compile cache: cold->warm reload, quarantine, rewrite passes) ..."
+timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
+
 echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate + sharding analyzer over the 4 dryrun meshes) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 

@@ -1,0 +1,331 @@
+"""paddle_tpu.compile — fingerprint stability, the persistent
+executable cache, and its executor wiring.
+
+The fingerprint tests are table-driven per ISSUE 9: the same Program
+rebuilt (even in a fresh process) must fingerprint identically, and
+ANY semantic change — an op attr, a dtype, a mesh axis, the pass
+pipeline — must change it.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.compile import fingerprint, pcache
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.fluid import executor as executor_mod
+from paddle_tpu.obs import telemetry as obs_tele
+from paddle_tpu.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _reset_compile_state():
+    yield
+    flags.set_flag("compile_cache_dir", "")
+    flags.set_flag("compile_passes", "")
+    pcache.reset()
+
+
+def _build_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="tanh")
+        y = fluid.layers.fc(input=h, size=2, act="softmax")
+    return main, startup, y.name
+
+
+def _fp(main, fetch, **kw):
+    kw.setdefault("feeds", ["x"])
+    kw.setdefault("fetches", [fetch])
+    return fingerprint.program_fingerprint(main, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint stability
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_same_program_rebuilt_same_fingerprint(self):
+        m1, _, f1 = _build_mlp()
+        m2, _, f2 = _build_mlp()
+        assert m1 is not m2
+        assert _fp(m1, f1) == _fp(m2, f2)
+
+    def test_clone_same_fingerprint(self):
+        m, _, f = _build_mlp()
+        assert _fp(m, f) == _fp(m.clone(), f)
+
+    def test_fresh_process_same_fingerprint(self):
+        """The restart contract: an independent interpreter building
+        the same Program computes the same fingerprint."""
+        m, _, f = _build_mlp()
+        here = _fp(m, f)
+        code = (
+            "import paddle_tpu.fluid as fluid\n"
+            "from paddle_tpu.compile import fingerprint\n"
+            "main, startup = fluid.Program(), fluid.Program()\n"
+            "with fluid.program_guard(main, startup):\n"
+            "    x = fluid.layers.data(name='x', shape=[8],"
+            " dtype='float32')\n"
+            "    h = fluid.layers.fc(input=x, size=4, act='tanh')\n"
+            "    y = fluid.layers.fc(input=h, size=2, act='softmax')\n"
+            "print(fingerprint.program_fingerprint(main, feeds=['x'],"
+            " fetches=[y.name]))\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        out = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                             env=env, capture_output=True, text=True,
+                             timeout=240)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip().splitlines()[-1] == here
+
+    @pytest.mark.parametrize("label,mutate", [
+        ("op attr", lambda m: m.global_block().desc.ops[0]
+            .attrs.update(extra_knob=3.0)),
+        ("var dtype", lambda m: setattr(
+            m.global_block().desc.vars["x"], "dtype", "int32")),
+        ("var shape", lambda m: setattr(
+            m.global_block().desc.vars["x"], "shape", (-1, 16))),
+        ("extra op", lambda m: m.global_block().desc.ops.append(
+            m.global_block().desc.ops[0])),
+        ("op order", lambda m: m.global_block().desc.ops.reverse()),
+    ])
+    def test_ir_changes_change_fingerprint(self, label, mutate):
+        m, _, f = _build_mlp()
+        base = _fp(m, f)
+        mutated = m.clone()
+        mutate(mutated)
+        assert _fp(mutated, f) != base, label
+
+    def test_context_changes_change_fingerprint(self):
+        m, _, f = _build_mlp()
+        base = _fp(m, f)
+        table = {
+            "feeds": _fp(m, f, feeds=["x", "x2"]),
+            "fetches": _fp(m, "other_fetch"),
+            "flags": _fp(m, f, flag_items=[("amp_bf16", True)]),
+            "pipeline": _fp(m, f, pipeline_id="v1:dce,cse"),
+            "mesh": _fp(m, f, mesh={"dp": 4, "mp": 2}),
+            "mesh axis": _fp(m, f, mesh={"dp": 8}),
+        }
+        for label, fp in table.items():
+            assert fp != base, label
+        assert len(set(table.values())) == len(table)
+
+    def test_values_signature(self):
+        a = np.zeros((2, 3), np.float32)
+        assert fingerprint.values_signature({"a": a}) == \
+            fingerprint.values_signature([("a", np.ones((2, 3),
+                                                        np.float32))])
+        assert fingerprint.values_signature({"a": a}) != \
+            fingerprint.values_signature(
+                {"a": np.zeros((2, 4), np.float32)})
+        assert fingerprint.values_signature({"a": a}) != \
+            fingerprint.values_signature(
+                {"a": np.zeros((2, 3), np.int32)})
+
+
+# ---------------------------------------------------------------------------
+# the persistent cache itself
+# ---------------------------------------------------------------------------
+
+def _compiled_unit(scale=2.0):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x * scale
+
+    return jax.jit(f).lower(jnp.ones((4,), jnp.float32)).compile()
+
+
+class TestPersistentCache:
+    def test_put_get_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        cache = pcache.PersistentCache(str(tmp_path))
+        kind = cache.put("a" * 64, _compiled_unit(),
+                         compile_seconds=0.5)
+        assert kind == "serialized"
+        loaded = cache.get("a" * 64)
+        assert loaded is not None
+        np.testing.assert_array_equal(
+            np.asarray(loaded(jnp.ones((4,), jnp.float32))),
+            np.full((4,), 2.0, np.float32))
+        snap = obs_tele.snapshot()
+        assert snap["compile_cache_hits_total"] == 1
+        assert snap["compile_cache_saved_compile_seconds_total"] \
+            == pytest.approx(0.5)
+
+    def test_missing_key_is_miss(self, tmp_path):
+        cache = pcache.PersistentCache(str(tmp_path))
+        assert cache.get("b" * 64) is None
+        assert obs_tele.snapshot()["compile_cache_misses_total"] == 1
+
+    def test_corrupt_entry_quarantined_not_raised(self, tmp_path):
+        cache = pcache.PersistentCache(str(tmp_path))
+        cache.put("c" * 64, _compiled_unit())
+        path = cache._entry_path("c" * 64)
+        blob = bytearray(open(path, "rb").read())
+        blob[-10] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        assert cache.get("c" * 64) is None  # miss, no exception
+        assert not os.path.exists(path)
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "quarantine", os.path.basename(path)))
+        snap = obs_tele.snapshot()
+        assert snap["compile_cache_errors_total{kind=corrupt}"] == 1
+
+    def test_truncated_entry_quarantined(self, tmp_path):
+        cache = pcache.PersistentCache(str(tmp_path))
+        cache.put("d" * 64, _compiled_unit())
+        path = cache._entry_path("d" * 64)
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[:len(blob) // 2])
+        assert cache.get("d" * 64) is None
+
+    def test_serialize_unsupported_stores_stub(self, tmp_path,
+                                               monkeypatch):
+        from jax.experimental import serialize_executable as se
+
+        def boom(compiled):
+            raise ValueError("Compilation does not support "
+                             "serialization")
+
+        monkeypatch.setattr(se, "serialize", boom)
+        cache = pcache.PersistentCache(str(tmp_path))
+        kind = cache.put("e" * 64, _compiled_unit(),
+                         compile_seconds=1.0)
+        assert kind == "stub"
+        assert cache.get("e" * 64) is None  # stub loads are misses
+        assert cache.stats()["entries"] == 1  # but stats see them
+
+    def test_lru_eviction_by_size(self, tmp_path):
+        cache = pcache.PersistentCache(str(tmp_path), max_bytes=1)
+        cache._max_bytes = 10 ** 9  # let both land first
+        cache.put("f" * 64, _compiled_unit())
+        os.utime(cache._entry_path("f" * 64), (1, 1))  # oldest-used
+        cache.put("g" * 64, _compiled_unit(3.0))
+        size_one = os.path.getsize(cache._entry_path("g" * 64))
+        cache._max_bytes = size_one  # room for exactly one entry
+        assert cache.evict() == 1
+        assert not os.path.exists(cache._entry_path("f" * 64))
+        assert os.path.exists(cache._entry_path("g" * 64))
+        assert obs_tele.snapshot()[
+            "compile_cache_evictions_total"] == 1
+
+    def test_gc_clears_quarantine(self, tmp_path):
+        cache = pcache.PersistentCache(str(tmp_path))
+        cache.put("h" * 64, _compiled_unit())
+        path = cache._entry_path("h" * 64)
+        open(path, "wb").write(b"garbage")
+        cache.get("h" * 64)  # quarantines
+        assert cache.stats()["quarantined"] == 1
+        summary = cache.gc()
+        assert summary["quarantine_cleared"] == 1
+        assert cache.stats()["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# executor wiring
+# ---------------------------------------------------------------------------
+
+def _build_scale_program(scale=2.0):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.scale(x=x, scale=scale)
+        z = fluid.layers.scale(x=y, scale=3.0)
+    return main, startup, z.name
+
+
+class TestExecutorPCache:
+    def _run(self, main, startup, fetch, x):
+        exe = executor_mod.Executor(executor_mod.CPUPlace())
+        with executor_mod.scope_guard(Scope()):
+            exe.run(startup)
+            return np.asarray(exe.run(main, feed={"x": x},
+                                      fetch_list=[fetch])[0])
+
+    def test_restart_reload_zero_compiles(self, tmp_path):
+        flags.set_flag("compile_cache_dir", str(tmp_path))
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        cold = self._run(*_build_scale_program(), x)
+        assert pcache.get_cache().stats()["entries"] > 0
+        pcache.reset()
+        before = obs_tele.jit_trace_count()
+        warm = self._run(*_build_scale_program(), x)
+        assert obs_tele.jit_trace_count() == before
+        np.testing.assert_array_equal(cold, warm)
+        assert obs_tele.snapshot()["compile_cache_hits_total"] >= 1
+
+    def test_different_shapes_get_distinct_entries(self, tmp_path):
+        flags.set_flag("compile_cache_dir", str(tmp_path))
+        main, startup, fetch = _build_scale_program()
+        exe = executor_mod.Executor(executor_mod.CPUPlace())
+        with executor_mod.scope_guard(Scope()):
+            exe.run(startup)
+            exe.run(main, feed={"x": np.zeros((2, 4), np.float32)},
+                    fetch_list=[fetch])
+            exe.run(main, feed={"x": np.zeros((5, 4), np.float32)},
+                    fetch_list=[fetch])
+        assert pcache.get_cache().stats()["entries"] == 2
+
+    def test_attr_change_misses(self, tmp_path):
+        flags.set_flag("compile_cache_dir", str(tmp_path))
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        self._run(*_build_scale_program(2.0), x)
+        hits0 = obs_tele.snapshot().get("compile_cache_hits_total", 0)
+        out = self._run(*_build_scale_program(5.0), x)
+        np.testing.assert_array_equal(out, x * 15.0)
+        assert obs_tele.snapshot().get("compile_cache_hits_total",
+                                       0) == hits0
+        assert pcache.get_cache().stats()["entries"] == 2
+
+    def test_disabled_flag_means_no_disk_io(self, tmp_path):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        self._run(*_build_scale_program(), x)
+        assert "compile_cache_hits_total" not in obs_tele.snapshot()
+        assert os.listdir(str(tmp_path)) == []
+
+    def test_corrupt_entry_recompiles_and_requarantines(self,
+                                                        tmp_path):
+        flags.set_flag("compile_cache_dir", str(tmp_path))
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        cold = self._run(*_build_scale_program(), x)
+        cache = pcache.get_cache()
+        entry = next(cache._iter_entries())
+        open(entry, "wb").write(b"PTPC1\nnot json\n")
+        pcache.reset()
+        out = self._run(*_build_scale_program(), x)
+        np.testing.assert_array_equal(cold, out)
+        assert pcache.get_cache().stats()["quarantined"] == 1
+        # the recompile re-stored a clean entry
+        assert pcache.get_cache().stats()["entries"] >= 1
+
+
+class TestProgramCacheEvictionMetric:
+    def test_eviction_counted_and_logged(self, monkeypatch, caplog):
+        import logging
+
+        monkeypatch.setattr(executor_mod.Executor, "_CACHE_MAX", 1)
+        exe = executor_mod.Executor(executor_mod.CPUPlace())
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        with executor_mod.scope_guard(Scope()), \
+                caplog.at_level(logging.DEBUG,
+                                logger="paddle_tpu.executor"):
+            for scale in (2.0, 3.0):
+                main, startup, fetch = _build_scale_program(scale)
+                exe.run(startup)
+                exe.run(main, feed={"x": x}, fetch_list=[fetch])
+        snap = obs_tele.snapshot()
+        assert snap["executor_program_cache_evictions_total"] >= 1
+        assert any("evicted program cache entry" in r.message
+                   for r in caplog.records)

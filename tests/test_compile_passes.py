@@ -1,0 +1,241 @@
+"""paddle_tpu.compile.passes — the Program-level rewrite engine."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.compile import passes
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.fluid import executor as executor_mod
+from paddle_tpu.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _reset_compile_flags():
+    yield
+    flags.set_flag("compile_passes", "")
+
+
+def _run(main, startup, fetch, feed):
+    exe = executor_mod.Executor(executor_mod.CPUPlace())
+    with executor_mod.scope_guard(Scope()):
+        exe.run(startup)
+        return np.asarray(exe.run(main, feed=feed,
+                                  fetch_list=[fetch])[0])
+
+
+def _op_types(program):
+    return [od.type for od in program.global_block().desc.ops]
+
+
+def _crafted():
+    """One program exercising every pass: a dead op (dce), a duplicate
+    pure op (cse), a static `shape` op (fold), and the vars they
+    orphan (dve)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.scale(x=x, scale=2.0)
+        fluid.layers.scale(x=x, scale=9.0)          # dead
+        y2 = fluid.layers.scale(x=x, scale=2.0)     # duplicate of y
+        z = fluid.layers.elementwise_add(x=y, y=y2)
+        blk = main.global_block()
+        sv = blk.create_var(name="shp_vec", dtype="int32", shape=[1])
+        blk.append_op(type="shape", inputs={"Input": [y.name]},
+                      outputs={"Out": [sv.name]}, infer_shape=False)
+        shp = fluid.layers.cast(x=sv, dtype="float32")
+        fin = fluid.layers.elementwise_add(
+            x=z, y=fluid.layers.reduce_sum(shp))
+    return main, startup, fin.name
+
+
+class TestIndividualPasses:
+    def test_dce_removes_dead_op(self):
+        main, _, fetch = _crafted()
+        opt = passes.PassManager("dce").run(main, fetches=[fetch])
+        assert _op_types(opt).count("scale") == 2  # dead one gone
+        assert _op_types(main).count("scale") == 3  # input untouched
+
+    def test_dce_keeps_everything_without_fetches(self):
+        # fetch is runtime-invisible: without the fetch set, sinks
+        # (the final add) would be false positives — nothing goes
+        main, _, fetch = _crafted()
+        opt = passes.PassManager("dce").run(main, fetches=[])
+        assert len(_op_types(opt)) == len(_op_types(main))
+
+    def test_fold_rewrites_static_shape_op(self):
+        main, startup, fetch = _crafted()
+        opt = passes.PassManager("fold").run(main, fetches=[fetch])
+        types = _op_types(opt)
+        assert "shape" not in types and "assign_value" in types
+        od = opt.global_block().desc.ops[types.index("assign_value")]
+        assert od.attrs["values"] == [4]
+
+    def test_fold_skips_dynamic_dims(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            # data layers get a -1 batch dim: never foldable
+            x = fluid.layers.data(name="x", shape=[4],
+                                  dtype="float32")
+            blk = main.global_block()
+            sv = blk.create_var(name="s", dtype="int32", shape=[2])
+            blk.append_op(type="shape", inputs={"Input": [x.name]},
+                          outputs={"Out": [sv.name]},
+                          infer_shape=False)
+        opt = passes.PassManager("fold").run(main, fetches=[sv.name])
+        assert "shape" in _op_types(opt)
+
+    def test_cse_dedupes_and_renames(self):
+        main, _, fetch = _crafted()
+        opt = passes.PassManager("cse").run(main, fetches=[fetch])
+        # the duplicate scale(x, 2.0) collapses; the dead 9.0 stays
+        assert _op_types(opt).count("scale") == 2
+        add = next(od for od in opt.global_block().desc.ops
+                   if od.type == "elementwise_add")
+        assert add.input("X") == add.input("Y")  # both renamed to y
+
+    def test_cse_respects_redefinition(self):
+        # two identical ops with a redefinition of the input between
+        # them compute DIFFERENT values: they must not merge
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            blk = main.global_block()
+            a = fluid.layers.scale(x=x, scale=2.0)
+            # redefine a in place (same name out as in)
+            blk.append_op(type="scale", inputs={"X": [a.name]},
+                          outputs={"Out": [a.name]},
+                          attrs={"scale": 5.0}, infer_shape=False)
+            b = fluid.layers.scale(x=x, scale=2.0)
+            out = fluid.layers.elementwise_add(x=a, y=b)
+        opt = passes.PassManager("cse").run(main, fetches=[out.name])
+        # `a` has two def sites -> not a CSE candidate; all ops stay
+        assert _op_types(opt).count("scale") == 3
+
+    def test_dve_sweeps_orphans(self):
+        main, _, fetch = _crafted()
+        pm = passes.PassManager("dce,cse,dve", explain=True)
+        opt = pm.run(main, fetches=[fetch])
+        removed = [r for r in pm.records if r["pass"] == "dve"][0]
+        assert removed["vars_after"] < removed["vars_before"]
+
+
+class TestControlFlow:
+    def test_dce_preserves_while_body(self):
+        """Regression: a while body's ops write carry vars DECLARED IN
+        THE PARENT block — the dead-op fixpoint must treat every
+        cross-block name as live, or the whole loop body looks dead
+        and the loop silently degenerates."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=0.0)
+            acc = fluid.layers.fill_constant(shape=[1],
+                                             dtype="float32",
+                                             value=0.0)
+            limit = fluid.layers.fill_constant(shape=[1],
+                                               dtype="float32",
+                                               value=5.0)
+            cond = fluid.layers.less_than(x=i, y=limit)
+            w = fluid.layers.While(cond=cond)
+            with w.block():
+                ni = fluid.layers.increment(x=i, value=1.0,
+                                            in_place=True)
+                nacc = fluid.layers.elementwise_add(x=acc, y=ni)
+                fluid.layers.assign(input=nacc, output=acc)
+                fluid.layers.less_than(x=ni, y=limit, cond=cond)
+            out = fluid.layers.scale(x=acc, scale=2.0)
+        opt = passes.PassManager("default").run(main,
+                                                fetches=[out.name])
+        assert len(opt.desc.block(1).ops) == \
+            len(main.desc.block(1).ops)
+        plain = _run(main, startup, out.name, {})
+        o = _run(opt, startup, out.name, {})
+        np.testing.assert_array_equal(plain, o)
+        assert float(plain[0]) == 30.0
+
+
+class TestPassManager:
+    def test_semantics_preserved_bit_identical(self):
+        main, startup, fetch = _crafted()
+        opt = passes.PassManager("default",
+                                 verify_level="full").run(
+            main, fetches=[fetch])
+        xv = np.arange(4, dtype=np.float32)
+        a = _run(main, startup, fetch, {"x": xv})
+        b = _run(opt, startup, fetch, {"x": xv})
+        np.testing.assert_array_equal(a, b)
+
+    def test_input_program_never_mutated(self):
+        main, _, fetch = _crafted()
+        before = main.desc.serialize_to_string()
+        passes.PassManager("default").run(main, fetches=[fetch])
+        assert main.desc.serialize_to_string() == before
+
+    def test_pipeline_id_stable_and_versioned(self):
+        pm = passes.PassManager("dce,cse")
+        assert pm.pipeline_id == "v%d:dce,cse" % passes._PIPELINE_VERSION
+        assert passes.PassManager("default").pipeline_id == \
+            passes.PassManager().pipeline_id
+        assert passes.pipeline_id("") == ""
+
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            passes.PassManager("dce,nope")
+
+    def test_explain_text(self):
+        main, _, fetch = _crafted()
+        pm = passes.PassManager("default", explain=True)
+        pm.run(main, fetches=[fetch])
+        text = pm.explain_text()
+        assert "pipeline v" in text and "dce" in text
+        assert "removed_ops" in text
+
+    def test_verifier_runs_around_every_pass(self, monkeypatch):
+        from paddle_tpu.analysis.diagnostics import \
+            ProgramVerificationError
+
+        class BreakIR(passes.RewritePass):
+            name = "dce"  # masquerade in the pipeline slot
+
+            def run(self, desc, ctx):
+                # drop a var another op still reads: V002
+                del desc.block(0).vars["x"]
+                return {"broke": ["it"]}
+
+        monkeypatch.setitem(passes._PASSES, "dce", BreakIR())
+        main, _, fetch = _crafted()
+        with pytest.raises(ProgramVerificationError):
+            passes.PassManager("dce").run(main, fetches=[fetch])
+
+
+class TestExecutorFlagWiring:
+    def test_flag_applies_pipeline(self):
+        main, startup, fetch = _crafted()
+        xv = np.arange(4, dtype=np.float32)
+        plain = _run(main, startup, fetch, {"x": xv})
+        flags.set_flag("compile_passes", "default")
+        optimized = _run(main, startup, fetch, {"x": xv})
+        np.testing.assert_array_equal(plain, optimized)
+        # the user's program object is untouched by the executor
+        assert _op_types(main).count("scale") == 3
+
+    def test_flag_flip_invalidates_program_cache(self):
+        # a flipped pass config must not reuse a _CompiledProgram
+        # built under the old one (the key encodes the flag, like amp)
+        main, startup, fetch = _crafted()
+        xv = np.arange(4, dtype=np.float32)
+        exe = executor_mod.Executor(executor_mod.CPUPlace())
+        with executor_mod.scope_guard(Scope()):
+            exe.run(startup)
+            exe.run(main, feed={"x": xv}, fetch_list=[fetch])
+            n_plain = len(exe._cache)
+            flags.set_flag("compile_passes", "default")
+            out = exe.run(main, feed={"x": xv}, fetch_list=[fetch])
+        assert len(exe._cache) == n_plain + 1
+        np.testing.assert_array_equal(
+            np.asarray(out[0]),
+            _run(main, startup, fetch, {"x": xv}))
