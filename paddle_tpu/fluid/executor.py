@@ -504,7 +504,9 @@ class _CompiledProgram:
         # this (segment, signature) from an AOT executable — loaded
         # from disk (zero XLA compiles) or compiled once and stored —
         # instead of the jit call path.  Disabled, this whole branch
-        # is one flag read.
+        # is one flag read.  `sig` is shared with the attribution
+        # branch below so one dispatch never hashes its inputs twice.
+        sig = None
         if flags.get_flag("compile_cache_dir"):
             from ..compile import fingerprint as fp_mod
 
@@ -522,19 +524,9 @@ class _CompiledProgram:
             if aot not in (None, False):
                 label = self._segment_label(i, seg)
                 try:
-                    if not (profiled or tracing):
-                        return aot(mut_ins, ro_ins, rng_state)
-                    t0 = time.perf_counter()
-                    outs, rng = aot(mut_ins, ro_ins, rng_state)
-                    jax.block_until_ready((outs, rng))
-                    dt = time.perf_counter() - t0
-                    if tracing:
-                        obs_trace.emit_span("executor/" + label, t0,
-                                            dt, cat="executor",
-                                            args={"pcache": True})
-                    if profiled:
-                        profiler_mod.record(label, dt)
-                    return outs, rng
+                    return self._exec_aot(aot, label, mut_ins, ro_ins,
+                                          rng_state, profiled, tracing,
+                                          "pcache")
                 except Exception as exc:
                     # signature drift / backend mismatch: quarantine
                     # THIS signature to the jit path and keep running
@@ -554,7 +546,39 @@ class _CompiledProgram:
                                  "(%r); falling back to jit path",
                                  label, exc)
 
+        # cost attribution on the plain jit path
+        # (FLAGS_xla_cost_attribution / health.force_attribution):
+        # jax's AOT artifacts don't share the jit call path's
+        # executable cache, so the old capture (`fn.lower().compile()`
+        # AFTER the jit call already compiled) paid a second,
+        # throwaway XLA compile per segment.  Instead, when
+        # attribution is wanted the first build goes THROUGH an AOT
+        # artifact — one compile that is both published and executed —
+        # and once a segment holds attribution artifacts they keep
+        # serving their signatures even after the flag drops (serving
+        # warmup under force_attribution must not recompile on the
+        # first real request).
         size_fn = getattr(jitted["fn"], "_cache_size", lambda: None)
+        want_attr = (flags.get_flag("xla_cost_attribution")
+                     or obs_health.attribution_forced())
+        attr = jitted.get("attr_aot")
+        has_live_attr = attr and any(v is not False
+                                     for v in attr.values())
+        if want_attr or has_live_attr:
+            # only build NEW attribution artifacts for fresh segment
+            # builds (first build, or a segment the jit call path
+            # never compiled): flipping the flag on a live process
+            # must not stall steady-state steps with inline recompiles
+            # of already-warm signatures (the old _capture_xla_cost
+            # also captured first builds only)
+            allow_compile = want_attr and (
+                first_call or not (size_fn() or 0))
+            res = self._run_attr_aot(i, seg, jitted, mut_ins, ro_ins,
+                                     rng_state, allow_compile,
+                                     profiled, tracing, sig)
+            if res is not None:
+                return res
+
         if not (profiled or tracing):
             # hot path: dispatch async; compile detection stays on (a
             # retrace is the single costliest event, telemetry must see
@@ -566,10 +590,6 @@ class _CompiledProgram:
                               and post_traces is not None
                               and post_traces > pre_traces):
                 obs_tele.on_jit_trace(self._segment_label(i, seg))
-            if first_call:
-                self._capture_xla_cost(jitted["fn"],
-                                       self._segment_label(i, seg),
-                                       (mut_ins, ro_ins, rng_state))
             return outs, rng
         # profiled/traced: block on the segment's outputs so the wall
         # time is the device time, not just the dispatch (ParseEvents
@@ -595,9 +615,6 @@ class _CompiledProgram:
         if profiled:
             profiler_mod.record(
                 label + ("/first(trace)" if traced else ""), dt)
-        if first_call:
-            self._capture_xla_cost(jitted["fn"], label,
-                                   (mut_ins, ro_ins, rng_state))
         return outs, rng
 
     def _pcache_base(self):
@@ -647,7 +664,8 @@ class _CompiledProgram:
                 if flags.get_flag("xla_cost_attribution") \
                         or obs_health.attribution_forced():
                     # attribution rides the loaded artifact — free on
-                    # a hit (no recompile; see _capture_xla_cost)
+                    # a hit, no recompile (the plain jit path gets the
+                    # same property from _run_attr_aot)
                     obs_health.publish_compile_stats(label, loaded)
                 return loaded
             t0 = time.perf_counter()
@@ -674,30 +692,86 @@ class _CompiledProgram:
                 pass
             return None
 
-    @staticmethod
-    def _capture_xla_cost(fn, label, args):
-        """Best-effort per-segment memory/FLOP attribution at jit-build
-        time (FLAGS_xla_cost_attribution): `fn.lower(...).compile()`
-        then `compiled.memory_analysis()/cost_analysis()` land in the
-        `xla_*{segment=...}` gauges.  The AOT path does NOT share the
-        jit call path's executable cache (measured, jax 0.4.37), so
-        this re-runs the XLA compile — roughly doubling a segment's
-        first-build cost — which is why the flag defaults off and only
-        startup-budget surfaces (serving warmup, bench legs that can
-        afford it) enable it.  With the persistent executable cache on
-        (FLAGS_compile_cache_dir), segments take the AOT path in
-        _aot_acquire and attribution is published from the SAME
-        lowered artifact — free on both a compile and a disk hit —
-        so this double-compile only remains on the plain jit path.
-        Runtimes that expose neither analysis are skipped silently."""
-        if not (flags.get_flag("xla_cost_attribution")
-                or obs_health.attribution_forced()):
-            return
+    def _run_attr_aot(self, i, seg, jitted, mut_ins, ro_ins, rng_state,
+                      allow_compile, profiled, tracing, sig=None):
+        """Attribution on the plain jit path, without the historical
+        double compile: per (segment, signature) the FIRST build is
+        `fn.lower().compile()` — the memory/cost analyses are
+        published from that artifact AND the artifact executes the
+        step, so attribution costs zero extra XLA compiles (the AOT
+        path does not share the jit call path's executable cache,
+        measured on jax 0.4.37 — hence executing the artifact instead
+        of discarding it).  Returns (outs, rng), or None to fall back
+        to the jit call path: an unknown signature with
+        `allow_compile` off (post-warmup retraces, and signatures
+        already warm in the jit cache, compile through the normal jit
+        path), a failed lowering, or a signature quarantined by an
+        execute failure.  `sig` reuses the pcache branch's signature
+        when that branch already computed it."""
+        from ..compile import fingerprint as fp_mod
+
+        attr = jitted.setdefault("attr_aot", {})
+        if sig is None:
+            try:
+                sig = fp_mod.values_signature_key(
+                    list(mut_ins.items()) + list(ro_ins.items())
+                    + [("@rng", rng_state)])
+            except Exception:
+                return None
+        aot = attr.get(sig)
+        if aot is False:
+            return None
+        label = self._segment_label(i, seg)
+        if aot is None:
+            if not allow_compile:
+                return None
+            try:
+                compiled = jitted["fn"].lower(
+                    mut_ins, ro_ins, rng_state).compile()
+            except Exception:
+                attr[sig] = False
+                return None  # jit path reports its own trace error
+            # a real XLA compile: telemetry must see it, exactly like
+            # a jit-call-path trace would have been counted
+            obs_tele.on_jit_trace(label)
+            obs_health.publish_compile_stats(label, compiled)
+            attr[sig] = aot = compiled
         try:
-            compiled = fn.lower(*args).compile()
-        except Exception:
-            return  # lowering the aval signature failed: skip quietly
-        obs_health.publish_compile_stats(label, compiled)
+            return self._exec_aot(aot, label, mut_ins, ro_ins,
+                                  rng_state, profiled, tracing,
+                                  "attr_aot")
+        except Exception as exc:
+            # same contract as the pcache execute fallback: quarantine
+            # THIS signature, keep running — unless dispatch already
+            # donated (deleted) the mutable inputs, where a re-run
+            # would only mask the real error
+            attr[sig] = False
+            if any(getattr(v, "is_deleted", lambda: False)()
+                   for v in mut_ins.values()):
+                raise
+            _log.warning("cost-attribution executable for %s failed "
+                         "(%r); falling back to jit path", label, exc)
+            return None
+
+    @staticmethod
+    def _exec_aot(aot, label, mut_ins, ro_ins, rng_state, profiled,
+                  tracing, span_flag):
+        """Dispatch one AOT artifact under the shared timing contract:
+        async on the hot path; blocked + span/profiler rows when
+        profiled or tracing (`span_flag` names which AOT path this
+        was).  Raises on failure — the caller owns quarantine."""
+        if not (profiled or tracing):
+            return aot(mut_ins, ro_ins, rng_state)
+        t0 = time.perf_counter()
+        outs, rng = aot(mut_ins, ro_ins, rng_state)
+        jax.block_until_ready((outs, rng))
+        dt = time.perf_counter() - t0
+        if tracing:
+            obs_trace.emit_span("executor/" + label, t0, dt,
+                                cat="executor", args={span_flag: True})
+        if profiled:
+            profiler_mod.record(label, dt)
+        return outs, rng
 
 
 # ---------------------------------------------------------------------------
@@ -773,8 +847,16 @@ class Executor:
         with run_span:
             feed_env = {}
             block0 = program.desc.block(0)
-            for name, val in feed.items():
-                feed_env[name] = self._prepare_feed(block0, name, val)
+            if feed:
+                t_feed = time.perf_counter()
+                for name, val in feed.items():
+                    feed_env[name] = self._prepare_feed(block0, name,
+                                                        val)
+                # input time as a counter of seconds: snapshot_delta
+                # turns it into the per-step/per-leg h2d-INPUT share
+                # the obs.perf classifier reads (bytes alone can't say
+                # whether the feed path is the bottleneck)
+                obs_tele.on_feed_seconds(time.perf_counter() - t_feed)
 
             # dtype policy and the rewrite pipeline are trace-time
             # state: a flipped amp flag (or pass config) must not

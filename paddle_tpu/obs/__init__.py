@@ -25,6 +25,12 @@ split into composable pieces instead of one table):
                  step records dumped as a JSON post-mortem bundle from
                  executor/trainer/serving exception paths and an
                  excepthook (`obs_dump --flight` renders one).
+  * `perf`     — continuous step profiler (per-step time-split records
+                 in a bounded ring, Chrome-trace/JSONL export), the
+                 bottleneck classifier (compute/hbm/input/host verdicts
+                 over the fluid/analysis roofline + XLA attribution),
+                 and the perf-history regression gate behind `pperf`
+                 (tools/perf_cli.py).
 
 Everything is import-cheap and off by default: with tracing disabled a
 span is one attribute load + one `is` check, registry counters are
@@ -40,5 +46,7 @@ from . import registry
 from . import telemetry
 from . import health
 from . import flight
+from . import perf
 
-__all__ = ["trace", "registry", "telemetry", "health", "flight"]
+__all__ = ["trace", "registry", "telemetry", "health", "flight",
+           "perf"]

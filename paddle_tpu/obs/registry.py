@@ -227,6 +227,49 @@ class Histogram(_Metric):
         with self._lock:
             return self._max
 
+    def _count_below_locked(self, value):
+        total = 0.0
+        lo = 0.0
+        for bound, n in zip(self.bounds, self._counts):
+            if value >= bound:
+                total += n
+                lo = bound
+            else:
+                if bound > lo and value > lo:
+                    total += n * (value - lo) / (bound - lo)
+                return total
+        if value > lo:
+            total += self._counts[-1]
+        return total
+
+    def count_below(self, value):
+        """Estimated observations <= `value`, interpolating linearly
+        inside the bucket containing it (prometheus histogram_quantile
+        semantics, inverted).  Observations in the +Inf bucket only
+        count when `value` is beyond the largest finite bound — their
+        true positions are unknowable.  The SLO burn tracker reads its
+        'requests within objective' numerator off this."""
+        with self._lock:
+            return self._count_below_locked(value)
+
+    def count_and_below(self, value):
+        """`(count, count_below(value))` as ONE consistent snapshot —
+        two separate reads could straddle a concurrent observe(),
+        yielding below > count and corrupting windowed ratios (the
+        SLO burn tracker's failure mode)."""
+        with self._lock:
+            return self._total, self._count_below_locked(value)
+
+    def fraction_below(self, value):
+        """`count_below(value) / count` — 1.0 on an empty histogram
+        (no observations violate any objective)."""
+        with self._lock:
+            total = self._total
+            below = self._count_below_locked(value)
+        if total == 0:
+            return 1.0
+        return min(1.0, below / total)
+
     def _render_samples(self):
         lines = []
         base = tuple(self._labels)

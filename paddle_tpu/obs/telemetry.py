@@ -25,8 +25,9 @@ from . import registry as registry_mod
 from . import trace as trace_mod
 
 __all__ = ["on_executor_run", "on_jit_trace", "on_transfer",
-           "on_program_cache_evict", "jit_trace_count",
-           "transfer_bytes", "step", "set_gauge", "snapshot",
+           "on_feed_seconds", "on_program_cache_evict",
+           "jit_trace_count", "transfer_bytes", "step", "set_gauge",
+           "install_step_observer", "step_observer", "snapshot",
            "snapshot_delta", "snapshot_and_delta"]
 
 # histogram bounds for step wall time: sub-ms tiny CPU steps up to
@@ -75,6 +76,18 @@ def on_program_cache_evict():
                    "executor's LRU cache").inc()
 
 
+def on_feed_seconds(seconds):
+    """Wall time the executor spent preparing feeds (dtype casts, the
+    int64 guard, host->device placement) for one run.  A counter of
+    seconds, so `snapshot_delta` attributes input time per step/leg —
+    the h2d-INPUT half of the time split that `on_transfer` only
+    reports in bytes."""
+    if seconds > 0:
+        _reg().counter("executor_feed_seconds_total",
+                       "seconds spent preparing/placing executor "
+                       "feeds (host->device input time)").inc(seconds)
+
+
 def on_transfer(direction, nbytes):
     """Host<->device bytes moved by the executor feed/fetch paths.
     direction: "h2d" (feeds placed on device) or "d2h" (fetches pulled
@@ -97,11 +110,31 @@ def transfer_bytes(direction):
 # trainer-side hooks
 # ---------------------------------------------------------------------------
 
+# single step observer slot (obs.perf.StepProfiler): begin_step() at
+# step entry, end_step() at exit.  One None check per step when empty.
+_step_observer = None
+
+
+def install_step_observer(observer):
+    """Register `observer` (needs begin_step(trainer) /
+    end_step(trainer, dt, examples, failed=...)) on every
+    `telemetry.step(...)` boundary; pass None to remove.  Returns the
+    previous observer so callers can restore it."""
+    global _step_observer
+    prev = _step_observer
+    _step_observer = observer
+    return prev
+
+
+def step_observer():
+    return _step_observer
+
+
 class _StepTimer:
     """Times one training step; on exit feeds the trainer metric
     family and leaves a `<trainer>/step` span on the trace."""
 
-    __slots__ = ("trainer", "examples", "args", "_t0")
+    __slots__ = ("trainer", "examples", "args", "_t0", "_obs")
 
     def __init__(self, trainer, examples, args):
         self.trainer = trainer
@@ -109,6 +142,11 @@ class _StepTimer:
         self.args = args
 
     def __enter__(self):
+        # pin the observer for the step: an install/uninstall mid-step
+        # must not end a step that was never begun (or vice versa)
+        self._obs = _step_observer
+        if self._obs is not None:
+            self._obs.begin_step(self.trainer)
         self._t0 = time.perf_counter()
         return self
 
@@ -117,6 +155,9 @@ class _StepTimer:
         dt = time.perf_counter() - t0
         trace_mod.emit_span(self.trainer + "/step", t0, dt,
                             cat="trainer", args=self.args)
+        if self._obs is not None:
+            self._obs.end_step(self.trainer, dt, self.examples,
+                               failed=exc_type is not None)
         if exc_type is not None:
             return False
         reg = _reg()

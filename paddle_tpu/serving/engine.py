@@ -186,13 +186,19 @@ class InferenceEngine:
     # -- compile-cache accounting -------------------------------------------
     def trace_count(self):
         """Total jit specializations across every compiled segment —
-        the ground truth for 'did that request recompile'."""
+        the ground truth for 'did that request recompile'.  Counts the
+        jit call path's cache PLUS attribution AOT artifacts (each one
+        was a real XLA compile, executor._run_attr_aot); persistent-
+        cache `aot` entries stay uncounted — a disk hit is the
+        opposite of a recompile."""
         n = 0
         for compiled in self._exe._cache.values():
             for jitted in compiled._jit_cache.values():
                 size = getattr(jitted["fn"], "_cache_size", None)
                 if size is not None:
                     n += size() or 0
+                n += sum(1 for v in jitted.get("attr_aot", {}).values()
+                         if v is not False)
         return n
 
     # -- padding ------------------------------------------------------------
@@ -383,11 +389,11 @@ class InferenceEngine:
             return 0
         # warmup compiles are startup cost, not traffic: keep them out
         # of the request-path latency histograms and hit/miss counters.
-        # Memory/cost attribution is ON for these builds — the capture
-        # re-runs each segment's XLA compile (see Executor.
-        # _capture_xla_cost), roughly doubling warmup time, a deploy-
-        # time price paid once so /metrics carries the per-bucket
-        # xla_* footprints before traffic arrives.  force_attribution
+        # Memory/cost attribution is ON for these builds — each
+        # segment compiles ONCE through an AOT artifact that is both
+        # published and kept for execution (executor._run_attr_aot),
+        # so /metrics carries the per-bucket xla_* footprints before
+        # traffic arrives at no extra compile cost.  force_attribution
         # is a counting override, so concurrent warmups in one process
         # can't race a flag save/restore.
         from ..obs import health as obs_health

@@ -19,7 +19,7 @@ from ..obs.registry import (Counter, Gauge, Histogram, MetricsRegistry,
                             DEFAULT_LATENCY_BUCKETS, get_registry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "ServingMetrics", "DEFAULT_LATENCY_BUCKETS"]
+           "ServingMetrics", "SLOTracker", "DEFAULT_LATENCY_BUCKETS"]
 
 
 class ServingMetrics:
@@ -92,3 +92,77 @@ class ServingMetrics:
         mount, so a scrape of an older server stays self-consistent)."""
         return get_registry().render_text(
             override_groups={"serving": self.registry})
+
+
+class SLOTracker:
+    """Latency-objective burn rate over the existing request-latency
+    histogram (`serving_total_seconds`) — no second timing path.
+
+    The objective is "`target` of requests answer within
+    `objective_ms`"; the error budget is the allowed violating
+    fraction (1 - target).  Each `update()` reads the histogram's
+    cumulative (count, count-below-objective) pair, diffs it against
+    the previous update, and publishes
+
+        burn = violating_fraction_in_window / (1 - target)
+
+    into the default registry as `slo_burn_rate{model=...}` — burn 1.0
+    means the budget is being consumed exactly as provisioned, > 1
+    means the SLO fails if the window's behavior persists (the
+    standard burn-rate alarm semantics).  The window IS the update
+    cadence: /healthz polls define it, which matches how the gauge is
+    consumed.  A window with no traffic burns nothing (0.0).  The
+    within-objective count interpolates linearly inside the histogram
+    bucket containing the objective (registry.Histogram.count_below),
+    so the objective need not sit on a bucket bound."""
+
+    def __init__(self, metrics, objective_ms, target=0.99,
+                 model="default"):
+        import threading
+
+        if not 0.0 < float(target) < 1.0:
+            raise ValueError("slo target must be in (0, 1); got %r"
+                             % (target,))
+        self.objective_s = float(objective_ms) / 1e3
+        self.target = float(target)
+        self.model = str(model)
+        self._hist = metrics.total_seconds
+        if self.objective_s > self._hist.bounds[-1]:
+            # beyond the largest finite bucket, every +Inf observation
+            # (including violations) would count as within objective
+            # and the burn could never rise above 0
+            raise ValueError(
+                "slo objective %gms exceeds the latency histogram's "
+                "largest finite bucket (%gs); violations beyond it "
+                "are unmeasurable" % (float(objective_ms),
+                                      self._hist.bounds[-1]))
+        self._lock = threading.Lock()  # /healthz probes are threaded
+        self._prev = (0, 0.0)  # cumulative (count, count_below)
+        self._gauge = get_registry().gauge(
+            "slo_burn_rate",
+            "latency-SLO error-budget burn rate per model "
+            "(violating fraction / allowed fraction, over the "
+            "window between updates)", labelnames=("model",)) \
+            .labels(model=self.model)
+        self._gauge.set(0.0)
+
+    def update(self):
+        """Recompute the burn over the window since the last update;
+        publishes and returns it.  Locked: concurrent /healthz probes
+        (liveness + scraper) must window against disjoint `_prev`
+        states, not race a read-modify-write."""
+        with self._lock:
+            # one consistent (count, below) pair: separate reads could
+            # straddle a concurrent observe() and report below > count
+            count, good = self._hist.count_and_below(self.objective_s)
+            prev_count, prev_good = self._prev
+            self._prev = (count, good)
+        d_count = count - prev_count
+        if d_count <= 0:
+            burn = 0.0
+        else:
+            bad_frac = max(0.0, 1.0 - (good - prev_good) / d_count)
+            burn = bad_frac / (1.0 - self.target)
+        burn = round(burn, 6)
+        self._gauge.set(burn)
+        return burn

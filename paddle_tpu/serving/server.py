@@ -28,15 +28,23 @@ import numpy as np
 from ..core.ragged import RaggedTensor
 from .batcher import (MicroBatcher, BatcherConfig, QueueFullError,
                       DeadlineExceededError, ShuttingDownError)
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, SLOTracker
 
 __all__ = ["ServerConfig", "InferenceServer"]
 
 
 class ServerConfig:
+    """slo_ms / slo_target / model_name declare this server's latency
+    objective ("slo_target of requests answer within slo_ms"): the
+    request-latency histogram is folded into a
+    `slo_burn_rate{model=model_name}` gauge surfaced in /metrics and
+    /healthz (docs/SERVING.md has the burn contract).  slo_ms=None
+    (the default) disables SLO tracking entirely."""
+
     def __init__(self, host="127.0.0.1", port=8500, max_batch=32,
                  max_wait_ms=5.0, queue_size=64, default_timeout_ms=None,
-                 warmup=True):
+                 warmup=True, slo_ms=None, slo_target=0.99,
+                 model_name="default"):
         self.host = host
         self.port = int(port)
         self.max_batch = int(max_batch)
@@ -44,6 +52,9 @@ class ServerConfig:
         self.queue_size = int(queue_size)
         self.default_timeout_ms = default_timeout_ms
         self.warmup = bool(warmup)
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        self.slo_target = float(slo_target)
+        self.model_name = str(model_name)
 
 
 def _to_list(arr):
@@ -121,6 +132,10 @@ class InferenceServer:
                 queue_size=self.config.queue_size,
                 default_timeout_ms=self.config.default_timeout_ms),
             metrics=self.metrics)
+        self.slo = (None if self.config.slo_ms is None
+                    else SLOTracker(self.metrics, self.config.slo_ms,
+                                    target=self.config.slo_target,
+                                    model=self.config.model_name))
         self.draining = False
         self._httpd = None
         self._http_thread = None
@@ -173,7 +188,7 @@ class InferenceServer:
             "NaN/Inf elements observed in watched tensors",
             labelnames=("tensor",))
         m = self.metrics
-        return {
+        body = {
             "status": "draining" if self.draining else "ok",
             "queue_depth": m.queue_depth.value,
             "inflight_batches": m.inflight.value,
@@ -188,6 +203,13 @@ class InferenceServer:
                 s["value"] for s in nonfinite_fam.samples()),
             "jit_traces_total": obs_tele.jit_trace_count(),
         }
+        if self.slo is not None:
+            # the probe cadence defines the burn window (SLOTracker)
+            body["slo_burn_rate"] = self.slo.update()
+            body["slo"] = {"model": self.config.model_name,
+                           "objective_ms": self.config.slo_ms,
+                           "target": self.config.slo_target}
+        return body
 
     # -- request handling ---------------------------------------------------
     def _parse_inputs(self, payload):

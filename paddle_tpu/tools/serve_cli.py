@@ -43,6 +43,14 @@ def parse_args(argv=None):
                    help="flat token-length multiple for ragged feeds")
     p.add_argument("--no_warmup", action="store_true",
                    help="skip pre-compiling the buckets at startup")
+    p.add_argument("--slo_ms", type=float, default=None,
+                   help="latency objective: publish slo_burn_rate in "
+                        "/metrics and /healthz (docs/SERVING.md)")
+    p.add_argument("--slo_target", type=float, default=0.99,
+                   help="fraction of requests that must answer "
+                        "within --slo_ms")
+    p.add_argument("--model_name", default="default",
+                   help="model label on the slo_burn_rate gauge")
     p.add_argument("--selftest", action="store_true",
                    help="serve a built-in tiny model, fire one "
                         "request, scrape /metrics, drain, exit")
@@ -70,7 +78,8 @@ def _serve(engine, args, ready=None):
         host=args.host, port=args.port, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, queue_size=args.queue_size,
         default_timeout_ms=args.timeout_ms,
-        warmup=not args.no_warmup))
+        warmup=not args.no_warmup, slo_ms=args.slo_ms,
+        slo_target=args.slo_target, model_name=args.model_name))
     server.start()
     host, port = server.address
     print("[serve] listening on http://%s:%d (feeds=%s fetches=%s "

@@ -91,13 +91,15 @@ DEFINE_flag("bn_shifted_stats", False,
             "with a >=0 clamp, fine for normalized inputs")
 DEFINE_flag("xla_cost_attribution", False,
             "capture per-segment XLA memory/cost analyses at jit-build "
-            "time into xla_* registry gauges (obs/health.py).  The AOT "
-            "capture path re-runs the XLA compile (jax's call-path "
-            "executable cache is not shared), roughly doubling a "
-            "segment's first-build cost — hence default off; serving "
-            "warmup and mega_bench's non-risky legs enable it, the "
-            "surfaces whose /metrics and BENCH artifacts consume the "
-            "attribution and can afford the startup cost")
+            "time into xla_* registry gauges (obs/health.py).  Each "
+            "segment's first build per signature goes through an AOT "
+            "artifact that is both published and executed (executor."
+            "_run_attr_aot) — one XLA compile, no throwaway capture "
+            "compile.  Default off only because the flag changes the "
+            "dispatch path (AOT call instead of jax.jit's) for "
+            "segments it touched; serving warmup and mega_bench's "
+            "non-risky legs enable it, the surfaces whose /metrics "
+            "and BENCH artifacts consume the attribution")
 DEFINE_flag("verify_program", False,
             "run paddle_tpu.analysis verification on every program "
             "before its FIRST compile (per executor + program "

@@ -37,6 +37,9 @@ timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 echo "[ci] pcc selftest (cold compile populates cache, restart reload = 0 XLA compiles, corrupt entry quarantined, rewrite passes bit-identical) ..."
 timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 
+echo "[ci] pperf selftest (gate discriminates 20% regression + tpu-stale, step profiler ring/exports, loopback SLO burn, warm pcache blob) ..."
+timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
+
 echo "[ci] proglint selftest (verifier corruptions + sharding analyzer: lenet5/golden clean on 4 dryrun meshes, seeded S-code corruptions) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
@@ -50,8 +53,25 @@ for mesh in dp=4,mp=2 dp=2,mp=2,sp=2 pp=4,dp=2 dp=2,ep=4; do
 done
 
 echo "[ci] driver entry points ..."
+# two bench runs against one persistent compile cache: the cold run
+# populates it, the warm rerun's stamped compile_cache blob must show
+# hits (ROADMAP item 3: the cache is now ON for bench/mega_bench legs)
+_pcc_dir=$(mktemp -d)
+_hist=$(mktemp)
 BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
+    FLAGS_compile_cache_dir="$_pcc_dir" BENCH_HISTORY="$_hist" \
     python bench.py
+BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 \
+    FLAGS_compile_cache_dir="$_pcc_dir" BENCH_HISTORY="$_hist" \
+    python bench.py | python -c "
+import json, sys
+rec = json.loads(sys.stdin.readline())
+cc = rec.get('compile_cache') or {}
+assert cc.get('hits', 0) > 0, 'warm bench rerun reported no compile-cache hits: %r' % cc
+assert rec.get('perf') and rec['perf'].get('verdict'), 'BENCH record carries no perf blob: %r' % rec.get('perf')
+print('[ci] warm bench leg: %d pcache hits, verdict %s' % (cc['hits'], rec['perf']['verdict']))
+"
+rm -rf "$_pcc_dir" "$_hist"
 # the dryrun is DEFINED on virtual CPU devices; never claim the real
 # chip from CI — a wedged claim would starve the bench watcher
 timeout 900 python -c \

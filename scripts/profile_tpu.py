@@ -9,6 +9,12 @@ like the reference's ParseEvents summary but at HLO granularity
 Usage (from the repo root, on the TPU or CPU):
     python scripts/profile_tpu.py            # resnet50, batch 128
     BENCH_MODEL=vgg16 BENCH_BATCH=64 python scripts/profile_tpu.py
+
+NOTE: the "is this leg compute/HBM/input/host bound" triage that used
+to be read by hand off this table now lives in `pperf classify` and
+the per-leg BENCH "perf" blob (paddle_tpu.obs.perf, docs/PERF.md);
+this script remains the drill-down for per-HLO device time once the
+classifier has named the bottleneck.
 """
 
 import collections

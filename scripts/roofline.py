@@ -1,58 +1,27 @@
 #!/usr/bin/env python
-"""Roofline ceiling for a bench model's training step.
+"""RETIRED — superseded by `pperf classify` (paddle_tpu.obs.perf).
 
-    PYTHONPATH= JAX_PLATFORMS=cpu python scripts/roofline.py \
-        --model resnet50 --batch 128 --bf16
+The hand-run roofline table this script printed is now one half of the
+perf subsystem's bottleneck classifier:
 
-Prints the per-op-type floor table (fluid/analysis.py) for the same
-program bench.py times, so a measured step_ms can be read against its
-hardware floor directly.  Pure IR analysis: no chip, no compile.
+    PYTHONPATH= JAX_PLATFORMS=cpu python -m paddle_tpu.tools.perf_cli \
+        classify --model resnet50 --batch 128 [--step-ms 51.8]
+
+which prints the same fluid/analysis.py floor table AND, given a
+measured step, the compute/hbm/input/host verdict with the dominant op
+named (docs/PERF.md).  This stub forwards its arguments so existing
+invocations keep working.
 """
 
-import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet50")
-    ap.add_argument("--batch", type=int, default=128)
-    ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--class-dim", type=int, default=1000)
-    ap.add_argument("--bf16", action="store_true", default=True)
-    ap.add_argument("--f32", dest="bf16", action="store_false")
-    ap.add_argument("--peak-tflops", type=float, default=None,
-                    help="default: analysis.py v5e numbers (halved "
-                         "for f32)")
-    ap.add_argument("--hbm-gbps", type=float, default=None)
-    ap.add_argument("--topk", type=int, default=12)
-    args = ap.parse_args()
-
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.fluid import analysis
-    from paddle_tpu import models
-    from __graft_entry__ import _build_model
-
-    if args.bf16:
-        fluid.amp.enable_bf16()
-    fn = {"resnet50": models.resnet50, "alexnet": models.alexnet,
-          "vgg16": models.vgg16, "vgg19": models.vgg19,
-          "googlenet": models.googlenet,
-          "smallnet": models.smallnet_mnist_cifar}[args.model]
-    main_prog, _, _, _ = _build_model(fn, args.batch, args.image_size,
-                                      args.class_dim, with_loss=True)
-    peak = args.peak_tflops or (analysis.DEFAULT_PEAK_TFLOPS
-                                if args.bf16
-                                else analysis.DEFAULT_PEAK_TFLOPS / 2)
-    rep = analysis.roofline_report(
-        main_prog, peak_tflops=peak,
-        hbm_gbps=args.hbm_gbps or analysis.DEFAULT_HBM_GBPS,
-        bf16_act=args.bf16)
-    print(analysis.format_report(rep, topk=args.topk))
-
-
 if __name__ == "__main__":
-    main()
+    from paddle_tpu.tools import perf_cli
+
+    print("[roofline] retired: forwarding to `pperf classify` "
+          "(python -m paddle_tpu.tools.perf_cli classify ...)",
+          file=sys.stderr, flush=True)
+    sys.exit(perf_cli.main(["classify"] + sys.argv[1:]))

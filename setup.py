@@ -43,6 +43,7 @@ setup(
         "console_scripts": [
             "paddle_trainer=paddle_tpu.tools.trainer_cli:main",
             "paddle_serve=paddle_tpu.tools.serve_cli:main",
+            "pperf=paddle_tpu.tools.perf_cli:main",
         ],
     },
 )

@@ -38,7 +38,9 @@ def fresh_obs():
     assertions can't flake."""
     from paddle_tpu.obs import flight as obs_flight
     from paddle_tpu.obs import health as obs_health
+    from paddle_tpu.obs import perf as obs_perf
     from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.obs import telemetry as obs_tele
     from paddle_tpu.obs import trace as obs_trace
     from paddle_tpu.resilience import faults as r_faults
 
@@ -49,6 +51,8 @@ def fresh_obs():
     yield
     obs_health.disable()
     obs_flight.uninstall()
+    obs_perf.uninstall()
+    obs_tele.install_step_observer(None)
     obs_trace.disable()
     obs_trace.reset()
     r_faults.disable()

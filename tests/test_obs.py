@@ -231,6 +231,92 @@ def test_telemetry_snapshot_delta_counters_vs_gauges():
     assert "delta_untouched_total" not in d
 
 
+def test_telemetry_snapshot_delta_gauge_disappears():
+    """A gauge present in `before` but gone from the registry (reset,
+    or a family child that no longer renders) must simply drop out of
+    the delta — never KeyError, never report a phantom value."""
+    reg = obs_registry.get_registry()
+    reg.gauge("vanishing_gauge").set(3)
+    reg.counter("surviving_total").inc(1)
+    before = obs_tele.snapshot()
+    assert before["vanishing_gauge"] == 3
+    # a fresh registry: the gauge (and everything else) is gone
+    obs_registry.reset_registry()
+    reg2 = obs_registry.get_registry()
+    reg2.counter("surviving_total").inc(5)
+    d = obs_tele.snapshot_delta(before)
+    assert "vanishing_gauge" not in d
+    # the surviving counter diffs against the OLD snapshot's 1
+    assert d["surviving_total"] == 4
+    # and the degenerate case: delta against a gauge-only snapshot
+    # over an empty registry is just empty
+    obs_registry.reset_registry()
+    assert obs_tele.snapshot_delta({"vanishing_gauge": 3}) == {}
+
+
+def test_registry_concurrent_writers_exact_totals():
+    """Counter/histogram increments from many threads (racing the
+    labeled-family get-or-create path too) must land exactly; a
+    concurrent render/snapshot must neither crash nor corrupt."""
+    reg = obs_registry.get_registry()
+    n_threads, n_iter = 8, 400
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(n_iter):
+                reg.counter("conc_total").inc()
+                reg.counter("conc_labeled_total",
+                            labelnames=("worker",)) \
+                   .labels(worker="w%d" % (tid % 4)).inc()
+                reg.histogram(
+                    "conc_seconds",
+                    buckets=(0.001, 0.01, 0.1)).observe(0.01 * (i % 3))
+                reg.gauge("conc_gauge").set(i)
+        except Exception as exc:  # noqa: BLE001 — surface in main
+            errors.append(exc)
+
+    def reader():
+        try:
+            for _ in range(50):
+                text = reg.render_text()
+                validate_prometheus_text(text)
+                obs_tele.snapshot()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)] \
+        + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert reg.counter("conc_total").value == n_threads * n_iter
+    fam = reg.counter("conc_labeled_total", labelnames=("worker",))
+    assert sum(s["value"] for s in fam.samples()) == n_threads * n_iter
+    hist = reg.histogram("conc_seconds", buckets=(0.001, 0.01, 0.1))
+    assert hist.count == n_threads * n_iter
+    # the final render is stable and parseable after the storm
+    names = validate_prometheus_text(reg.render_text())
+    assert "conc_total" in names and "conc_labeled_total" in names
+
+
+def test_registry_histogram_count_below_interpolates():
+    h = obs_registry.Histogram("lat", buckets=(0.01, 0.1, 1.0))
+    assert h.fraction_below(0.05) == 1.0  # empty: nothing violates
+    for v in (0.005, 0.05, 0.5, 5.0):     # one per bucket incl +Inf
+        h.observe(v)
+    assert h.count_below(0.01) == 1
+    # halfway through the (0.01, 0.1] bucket: 1 full + 0.5 interp
+    assert abs(h.count_below(0.055) - 1.5) < 1e-9
+    assert h.count_below(1.0) == 3
+    # beyond the largest finite bound: the +Inf bucket counts
+    assert h.count_below(10.0) == 4
+    assert abs(h.fraction_below(0.1) - 0.5) < 1e-9
+
+
 def _tiny_program():
     x = fluid.layers.data(name="x", shape=[4], dtype="float32")
     h = fluid.layers.fc(input=x, size=3, act="relu")
