@@ -17,9 +17,11 @@ from .transpiler import (DistributeTranspiler, split_dense_variable,
 
 from .coordinator import (init_multihost, global_mesh, process_count,
                           process_index, ElasticRegistry, ServiceLease,
-                          discover_pservers)
+                          discover_pservers, start_fleet_reporter,
+                          stop_fleet_reporter)
 
 __all__ = ["DistributeTranspiler", "split_dense_variable", "run_pserver",
            "init_multihost", "global_mesh", "process_count",
            "process_index", "ElasticRegistry", "ServiceLease",
-           "discover_pservers"]
+           "discover_pservers", "start_fleet_reporter",
+           "stop_fleet_reporter"]

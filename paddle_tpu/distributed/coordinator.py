@@ -29,12 +29,34 @@ import os
 import threading
 import time
 
+from ..obs import registry as registry_mod
 from ..resilience import faults as faults_mod
 from ..resilience.retry import RetryPolicy
 
+# keep-alive RPC latency buckets: sub-ms loopback beats up to the
+# multi-second stalls that lapse a lease
+HEARTBEAT_SECONDS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01,
+                             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                             5.0)
+
+
+def _heartbeat_hist():
+    return registry_mod.get_registry().histogram(
+        "coordinator_heartbeat_seconds", HEARTBEAT_SECONDS_BUCKETS,
+        "lease keep-alive RPC latency (per attempt, including "
+        "injected faults)")
+
+
+def _heartbeat_failures():
+    return registry_mod.get_registry().counter(
+        "coordinator_heartbeat_failures_total",
+        "keep-alive attempts that raised (retried within the beat "
+        "budget before the lease lapses)")
+
 __all__ = ["init_multihost", "global_mesh", "process_count",
            "process_index", "ElasticRegistry", "ServiceLease",
-           "discover_pservers"]
+           "discover_pservers", "start_fleet_reporter",
+           "stop_fleet_reporter"]
 
 
 def discover_pservers(count=None, timeout=60.0, master=None):
@@ -54,13 +76,56 @@ def discover_pservers(count=None, timeout=60.0, master=None):
         reg.close()
 
 _initialized = [False]
+_fleet_reporter = [None]
+
+
+def start_fleet_reporter(master=None, host=None, interval_s=2.0):
+    """Start (or return) this process's fleet snapshot reporter
+    (obs.fleet.FleetReporter): periodic registry snapshots pushed
+    under /obs/<host> in the master's TTL-lease store, so an
+    aggregator anywhere can merge per-host metrics and flag
+    stragglers.  `master` defaults to $PADDLE_OBS_MASTER; returns
+    None when neither is set (reporting is strictly opt-in)."""
+    from ..obs import fleet as fleet_mod
+
+    existing = _fleet_reporter[0]
+    if existing is not None:
+        # explicit args that contradict the running reporter must not
+        # be silently dropped — the caller would believe snapshots
+        # reach the master it named
+        running = "%s:%d" % existing._master
+        if (master is not None and str(master) != running) \
+                or (host is not None and host != existing.host):
+            raise RuntimeError(
+                "fleet reporter already running (master %s, host %s); "
+                "stop_fleet_reporter() before starting one for "
+                "master=%r host=%r" % (running, existing.host,
+                                       master, host))
+        return existing
+    master = master or os.environ.get(fleet_mod.MASTER_ENV)
+    if not master:
+        return None
+    _fleet_reporter[0] = fleet_mod.FleetReporter(
+        master, host=host, interval_s=interval_s).start()
+    return _fleet_reporter[0]
+
+
+def stop_fleet_reporter():
+    rep = _fleet_reporter[0]
+    _fleet_reporter[0] = None
+    if rep is not None:
+        rep.stop()
+    return rep
 
 
 def init_multihost(coordinator=None, num_processes=None, process_id=None,
                    local_device_ids=None):
     """Bring up the multi-host JAX runtime.  No-ops on single-host
     (nothing set and no args) so user scripts can call it
-    unconditionally."""
+    unconditionally.  When the launcher exported PADDLE_OBS_MASTER
+    (cluster_launch.py --elastic does), the fleet snapshot reporter
+    starts alongside, so every multihost worker's metrics reach the
+    aggregated /obs/ view without per-script wiring."""
     import jax
 
     coordinator = coordinator or os.environ.get("PADDLE_COORDINATOR")
@@ -71,6 +136,7 @@ def init_multihost(coordinator=None, num_processes=None, process_id=None,
         pid = os.environ.get("PADDLE_PROCESS_ID")
         process_id = int(pid) if pid is not None else None
 
+    start_fleet_reporter()
     if coordinator is None and num_processes in (None, 1):
         return False  # single host; jax is already usable
     if _initialized[0]:
@@ -134,10 +200,17 @@ class ServiceLease:
         self._thread.start()
 
     def _one_beat(self):
-        faults_mod.check("coordinator/heartbeat")
+        # timed per ATTEMPT (fault sleeps included): the histogram is
+        # how an operator sees a master getting slow BEFORE leases
+        # start lapsing — renewals run at 1/3 TTL, so p99 creeping
+        # toward the beat interval is the early warning
+        t0 = time.perf_counter()
         try:
-            return self._client.keep_alive(self._lease)
+            faults_mod.check("coordinator/heartbeat")
+            alive = self._client.keep_alive(self._lease)
         except (ConnectionError, OSError):
+            _heartbeat_failures().inc()
+            _heartbeat_hist().observe(time.perf_counter() - t0)
             if self._reconnect is not None:
                 try:
                     self._client.close()
@@ -145,6 +218,8 @@ class ServiceLease:
                     pass
                 self._client = self._reconnect()
             raise
+        _heartbeat_hist().observe(time.perf_counter() - t0)
+        return alive
 
     def _beat(self):
         while not self._stop.wait(self._beat_interval):

@@ -25,6 +25,18 @@ split into composable pieces instead of one table):
                  step records dumped as a JSON post-mortem bundle from
                  executor/trainer/serving exception paths and an
                  excepthook (`obs_dump --flight` renders one).
+  * `context`  — request-scoped trace context: W3C-traceparent
+                 trace/span ids + request_id with a thread-local
+                 current binding, and per-request span recording that
+                 survives the serving batcher's thread hop.
+  * `tail`     — tail-latency capture: a bounded ring keeping the FULL
+                 span tree only for slow/errored requests
+                 (`obs_dump --tail` renders a dump; the serving
+                 server exposes `/debug/tail`).
+  * `fleet`    — fleet-wide aggregation: per-host registry snapshots
+                 pushed through the coordinator's TTL-lease store,
+                 merged with `host=` labels, with step-time skew and
+                 `fleet_straggler{host=}` detection.
   * `perf`     — continuous step profiler (per-step time-split records
                  in a bounded ring, Chrome-trace/JSONL export), the
                  bottleneck classifier (compute/hbm/input/host verdicts
@@ -47,6 +59,9 @@ from . import telemetry
 from . import health
 from . import flight
 from . import perf
+from . import context
+from . import tail
+from . import fleet
 
 __all__ = ["trace", "registry", "telemetry", "health", "flight",
-           "perf"]
+           "perf", "context", "tail", "fleet"]

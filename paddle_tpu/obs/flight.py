@@ -35,6 +35,7 @@ import threading
 import time
 import traceback as traceback_mod
 
+from . import context as context_mod
 from . import telemetry as telemetry_mod
 from . import trace as trace_mod
 
@@ -170,6 +171,13 @@ class FlightRecorder:
             "registry": telemetry_mod.snapshot(),
             "recent_spans": self._recent_spans(),
         }
+        # the request this thread was serving when it crashed: dump()
+        # runs on the crashing thread (excepthook / exception-path
+        # hooks), so the thread-local binding IS the dying request —
+        # the post-mortem names it instead of "some request"
+        ctx = context_mod.current()
+        if ctx is not None:
+            doc["trace_context"] = ctx.ids()
         if exc is not None:
             doc["exception"] = {
                 "type": type(exc).__name__,

@@ -618,9 +618,17 @@ class GateResult:
                 "checked": self.checked, "skipped": self.skipped}
 
 
-def _is_stale_platform(platform):
+def is_stale_platform(platform):
+    """True when a record's platform string marks a stale/degraded
+    re-emit (`*-stale`, `*-fallback`, or empty) — the class the gate
+    hard-fails.  Public so emitters (scripts/mega_bench.py) can warn
+    at EMIT time instead of leaving the discovery to gate time."""
     p = str(platform or "")
     return p.endswith("-stale") or p.endswith("-fallback") or p == ""
+
+
+# internal alias (pre-existing callers)
+_is_stale_platform = is_stale_platform
 
 
 def gate_history(records, baseline_n=DEFAULT_BASELINE_N,

@@ -22,6 +22,7 @@ serving `/metrics` endpoint export.
 import bisect
 import json
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry"]
@@ -81,6 +82,22 @@ class _Metric:
                 self._children[key] = child
             return child
 
+    def remove(self, **kv):
+        """Drop one labeled child from the family so it stops
+        rendering (prometheus_client's `remove()`): how publishers of
+        per-entity gauges (fleet per-host metrics) retire an entity
+        instead of freezing its last value forever.  No-op when the
+        child doesn't exist."""
+        if self._children is None:
+            raise ValueError("metric %s has no labelnames" % self.name)
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                "metric %s expects labels %s, got %s"
+                % (self.name, sorted(self.labelnames), sorted(kv)))
+        key = tuple(str(kv[k]) for k in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
     def _check_leaf(self):
         if self._children is not None:
             raise ValueError(
@@ -93,10 +110,25 @@ class _Metric:
         with self._lock:
             return list(self._children.values())
 
-    def render(self):
-        lines = ["# TYPE %s %s" % (self.name, self.kind)]
+    def family_name(self, openmetrics=False):
+        """The family name for TYPE/HELP lines.  OpenMetrics requires
+        counter FAMILIES named without the `_total` suffix (samples
+        keep it) — a strict OM parser rejects `# TYPE foo_total
+        counter`, and the OM exposition is the only one that carries
+        exemplars, so the negotiated render must comply."""
+        if openmetrics and self.kind == "counter" \
+                and self.name.endswith("_total"):
+            return self.name[:-len("_total")]
+        return self.name
+
+    def render(self, exemplars=False):
+        """`exemplars=True` means "render for an OpenMetrics scrape":
+        exemplar suffixes on histogram buckets AND OM-compliant
+        counter family names."""
+        lines = ["# TYPE %s %s" % (self.family_name(exemplars),
+                                   self.kind)]
         for leaf in self._leaves():
-            lines.extend(leaf._render_samples())
+            lines.extend(leaf._render_samples(exemplars=exemplars))
         return lines
 
     def samples(self):
@@ -136,7 +168,7 @@ class Counter(_Metric):
         with self._lock:
             return self._value
 
-    def _render_samples(self):
+    def _render_samples(self, exemplars=False):
         return ["%s%s %g" % (self.name, _label_str(self._labels),
                              self.value)]
 
@@ -176,7 +208,7 @@ class Gauge(_Metric):
         with self._lock:
             return self._value
 
-    def _render_samples(self):
+    def _render_samples(self, exemplars=False):
         return ["%s%s %g" % (self.name, _label_str(self._labels),
                              self.value)]
 
@@ -186,7 +218,15 @@ class Gauge(_Metric):
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (prometheus semantics: bucket `le`
-    counts include every observation <= bound, plus +Inf)."""
+    counts include every observation <= bound, plus +Inf).
+
+    `observe(value, exemplar=...)` additionally retains the LAST
+    exemplar per bucket — a small label dict (canonically
+    `{"trace_id": ...}`) naming one concrete observation that landed
+    there — rendered in OpenMetrics exemplar syntax
+    (`..._bucket{le="0.25"} 7 # {trace_id="ab12"} 0.21 <ts>`), so a
+    p99 latency bucket in /metrics links directly to a captured
+    trace instead of being an anonymous count."""
 
     kind = "histogram"
 
@@ -195,6 +235,7 @@ class Histogram(_Metric):
         super().__init__(name, help_text, labelnames)
         self.bounds = tuple(sorted(buckets))
         self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._exemplars = [None] * (len(self.bounds) + 1)
         self._sum = 0.0
         self._total = 0
         self._max = 0.0
@@ -202,7 +243,7 @@ class Histogram(_Metric):
     def _new_child(self):
         return Histogram(self.name, self.bounds, self.help_text)
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         self._check_leaf()
         idx = bisect.bisect_left(self.bounds, value)
         with self._lock:
@@ -211,6 +252,22 @@ class Histogram(_Metric):
             self._total += 1
             if value > self._max:
                 self._max = value
+            if exemplar is not None:
+                if not isinstance(exemplar, dict):
+                    exemplar = {"trace_id": str(exemplar)}
+                self._exemplars[idx] = (exemplar, value, time.time())
+
+    def exemplars(self):
+        """{le_bound_string: (labels, value, unix_ts)} for buckets that
+        hold one (`"+Inf"` keys the overflow bucket)."""
+        with self._lock:
+            out = {}
+            for bound, ex in zip(self.bounds, self._exemplars):
+                if ex is not None:
+                    out["%g" % bound] = ex
+            if self._exemplars[-1] is not None:
+                out["+Inf"] = self._exemplars[-1]
+            return out
 
     @property
     def count(self):
@@ -270,19 +327,37 @@ class Histogram(_Metric):
             return 1.0
         return min(1.0, below / total)
 
-    def _render_samples(self):
+    @staticmethod
+    def _exemplar_suffix(ex):
+        """OpenMetrics exemplar rendering: ` # {labels} value ts`."""
+        if ex is None:
+            return ""
+        labels, value, ts = ex
+        return " # %s %g %.3f" % (
+            _label_str(tuple(sorted(labels.items()))) or "{}", value, ts)
+
+    def _render_samples(self, exemplars=False):
+        """`exemplars=True` appends OpenMetrics exemplar suffixes to
+        bucket lines — syntax stock text-format-0.0.4 scrapers reject,
+        so the caller must only ask for it on a negotiated
+        `application/openmetrics-text` exposition (the serving
+        /metrics endpoint does the negotiation)."""
         lines = []
         base = tuple(self._labels)
         with self._lock:
             cum = 0
-            for bound, n in zip(self.bounds, self._counts):
+            for bound, n, ex in zip(self.bounds, self._counts,
+                                    self._exemplars):
                 cum += n
-                lines.append("%s_bucket%s %d" % (
+                lines.append("%s_bucket%s %d%s" % (
                     self.name, _label_str(base, (("le", "%g" % bound),)),
-                    cum))
+                    cum,
+                    self._exemplar_suffix(ex) if exemplars else ""))
             cum += self._counts[-1]
-            lines.append("%s_bucket%s %d" % (
-                self.name, _label_str(base, (("le", "+Inf"),)), cum))
+            lines.append("%s_bucket%s %d%s" % (
+                self.name, _label_str(base, (("le", "+Inf"),)), cum,
+                self._exemplar_suffix(self._exemplars[-1])
+                if exemplars else ""))
             lines.append("%s_sum%s %g" % (self.name, _label_str(base),
                                           self._sum))
             lines.append("%s_count%s %d" % (self.name, _label_str(base),
@@ -371,7 +446,12 @@ class MetricsRegistry:
         with self._lock:
             return self._groups.pop(name, None)
 
-    def render_text(self, override_groups=None):
+    def render_text(self, override_groups=None, exemplars=False):
+        """Prometheus text exposition.  `exemplars=True` adds
+        OpenMetrics exemplar suffixes on histogram buckets — only
+        valid on a scrape that negotiated
+        `application/openmetrics-text` (plain 0.0.4 scrapers reject
+        the syntax), so it defaults off."""
         with self._lock:
             metrics = list(self._metrics)
             groups = dict(self._groups)
@@ -380,10 +460,11 @@ class MetricsRegistry:
         lines = []
         for m in metrics:
             if m.help_text:
-                lines.append("# HELP %s %s" % (m.name, m.help_text))
-            lines.extend(m.render())
+                lines.append("# HELP %s %s"
+                             % (m.family_name(exemplars), m.help_text))
+            lines.extend(m.render(exemplars=exemplars))
         for key in sorted(groups):
-            sub = groups[key].render_text()
+            sub = groups[key].render_text(exemplars=exemplars)
             lines.extend(sub.rstrip("\n").splitlines())
         return "\n".join(lines) + "\n"
 

@@ -20,6 +20,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..core.ragged import RaggedTensor
+from ..obs import context as obs_context
 from ..obs import trace as obs_trace
 from .engine import _ragged_to_sequences
 
@@ -64,14 +65,20 @@ class BatcherConfig:
 
 
 class _Request:
-    __slots__ = ("feeds", "batch", "deadline", "future", "submitted")
+    __slots__ = ("feeds", "batch", "deadline", "future", "submitted",
+                 "submitted_wall", "ctx")
 
-    def __init__(self, feeds, batch, deadline):
+    def __init__(self, feeds, batch, deadline, ctx=None):
         self.feeds = feeds
         self.batch = batch
         self.deadline = deadline
+        # the request's trace context rides the queue hop WITH the
+        # request, so worker-thread stage records land in the right
+        # request's span tree however requests interleave
+        self.ctx = ctx
         self.future = Future()
         self.submitted = time.monotonic()
+        self.submitted_wall = time.time()
 
     def expired(self, now=None):
         return (self.deadline is not None
@@ -102,10 +109,12 @@ class MicroBatcher:
                 self._thread.start()
         return self
 
-    def submit(self, feeds, timeout_ms=None):
+    def submit(self, feeds, timeout_ms=None, ctx=None):
         """Enqueue one request; returns a Future resolving to the
         per-request fetch list.  Raises instead of queueing when the
-        server is draining or the admission queue is full."""
+        server is draining or the admission queue is full.  `ctx` (a
+        TraceContext) is carried across the queue hop — the worker
+        records queue-wait/batch/execute spans into it."""
         if self._draining:
             if self.metrics:
                 self.metrics.rejected_draining.inc()
@@ -115,7 +124,9 @@ class MicroBatcher:
             timeout_ms = self.config.default_timeout_ms
         deadline = (time.monotonic() + float(timeout_ms) / 1000.0
                     if timeout_ms is not None else None)
-        req = _Request(feeds, batch, deadline)
+        if ctx is None:
+            ctx = obs_context.current()
+        req = _Request(feeds, batch, deadline, ctx=ctx)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -129,8 +140,8 @@ class MicroBatcher:
             self.metrics.queue_depth.set(self._queue.qsize())
         return req.future
 
-    def submit_and_wait(self, feeds, timeout_ms=None):
-        fut = self.submit(feeds, timeout_ms=timeout_ms)
+    def submit_and_wait(self, feeds, timeout_ms=None, ctx=None):
+        fut = self.submit(feeds, timeout_ms=timeout_ms, ctx=ctx)
         # future timeout is a backstop over the request deadline; the
         # worker completes expired requests itself
         wait = (float(timeout_ms) / 1000.0 + 30.0
@@ -267,6 +278,35 @@ class MicroBatcher:
         # not batch-major (scalar summaries): every request gets it
         return [arr for _ in group]
 
+    @staticmethod
+    def _record_stages(live, now_wall, assemble_s, split_s, timings,
+                       occupancy, total_rows):
+        """Attribute the batch-level stage timings (measured ONCE) to
+        every co-batched request's span tree: queue wait, batch
+        assembly, pad/bucket, device execute, split — the request-side
+        half of the tail-capture contract (docs/OBSERVABILITY.md)."""
+        pad_s = timings.get("pad", 0.0)
+        compute_s = timings.get("compute", 0.0)
+        # reconstruct wall starts backwards from the post-split clock
+        t_split0 = now_wall - split_s
+        t_exec0 = t_split0 - compute_s
+        t_pad0 = t_exec0 - pad_s
+        t_asm0 = t_pad0 - assemble_s
+        for req in live:
+            ctx = req.ctx
+            if ctx is None:
+                continue
+            ctx.record("serving/queue_wait", req.submitted_wall,
+                       max(0.0, t_asm0 - req.submitted_wall))
+            ctx.record("serving/batch_assemble", t_asm0, assemble_s,
+                       args={"occupancy": occupancy,
+                             "rows": total_rows})
+            ctx.record("serving/pad_bucket", t_pad0, pad_s,
+                       args={"bucket": timings.get("bucket")})
+            ctx.record("serving/device_execute", t_exec0, compute_s,
+                       args={"compiled": timings.get("compiled")})
+            ctx.record("serving/split_serialize", t_split0, split_s)
+
     def _run_batch(self, group, rows):
         now = time.monotonic()
         live = []
@@ -288,19 +328,33 @@ class MicroBatcher:
             self.metrics.batch_rows.observe(sum(r.batch for r in live))
             self.metrics.inflight.inc()
         try:
+            timings = {}
             with obs_trace.span("serving/batch", cat="serving",
                                 occupancy=len(live),
                                 rows=sum(r.batch for r in live)):
-                outs = self.engine.run(self._merge_feeds(live))
+                t0 = time.perf_counter()
+                merged = self._merge_feeds(live)
+                t1 = time.perf_counter()
+                outs = self.engine.run(merged, timings=timings)
+            t2 = time.perf_counter()
             offsets = np.cumsum([0] + [r.batch for r in live])[:-1]
             per_fetch = [self._split_fetch(o, offsets, live)
                          for o in outs]
+            t3 = time.perf_counter()
+            self._record_stages(
+                live, time.time(), t1 - t0, t3 - t2, timings,
+                occupancy=len(live),
+                total_rows=sum(r.batch for r in live))
             for i, req in enumerate(live):
                 req.future.set_result([pf[i] for pf in per_fetch])
                 if self.metrics:
                     self.metrics.responses_total.inc()
                     self.metrics.observe_stage(
-                        "total", time.monotonic() - req.submitted)
+                        "total", time.monotonic() - req.submitted,
+                        # the exemplar links this latency bucket to
+                        # the request's trace in /metrics
+                        exemplar=(req.ctx.trace_id if req.ctx
+                                  else None))
         except Exception as exc:  # noqa: BLE001 — fail the requests, not the server
             if self.metrics:
                 self.metrics.errors_total.inc(len(live))

@@ -333,6 +333,7 @@ class InferenceEngine:
             timings["pad"] = t1 - t0
             timings["compute"] = t2 - t1
             timings["compiled"] = compiled
+            timings["bucket"] = bucket
         sliced = [self._slice_fetch(o, true_batch, bucket) for o in outs]
         if self.config.check_numerics:
             from ..obs import health as obs_health

@@ -78,20 +78,26 @@ class ServingMetrics:
         # keeps its own `registry` intact either way)
         get_registry().attach("serving", reg)
 
-    def observe_stage(self, stage, seconds):
+    def observe_stage(self, stage, seconds, exemplar=None):
         """Record a per-stage latency in both systems: the histogram
-        for /metrics scrapes and fluid.profiler for its table."""
+        for /metrics scrapes and fluid.profiler for its table.
+        `exemplar` (a trace id or label dict) is retained on the
+        histogram bucket and rendered in OpenMetrics exemplar syntax,
+        so a latency bucket links to a concrete trace."""
         hist = getattr(self, stage + "_seconds")
-        hist.observe(seconds)
+        hist.observe(seconds, exemplar=exemplar)
         profiler_mod.record("serving/" + stage, seconds)
 
-    def render_text(self):
+    def render_text(self, exemplars=False):
         """The UNIFIED exposition: executor/trainer/profiler metrics
         from the default registry plus this instance's serving metrics
         (overriding whatever instance currently holds the "serving"
-        mount, so a scrape of an older server stays self-consistent)."""
+        mount, so a scrape of an older server stays self-consistent).
+        `exemplars=True` is for OpenMetrics-negotiated scrapes only
+        (registry.render_text)."""
         return get_registry().render_text(
-            override_groups={"serving": self.registry})
+            override_groups={"serving": self.registry},
+            exemplars=exemplars)
 
 
 class SLOTracker:

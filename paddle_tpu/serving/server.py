@@ -21,11 +21,14 @@ scrapeable and curl-able, which the /metrics endpoint needs anyway.
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..core.ragged import RaggedTensor
+from ..obs import context as obs_context
+from ..obs import tail as obs_tail
 from .batcher import (MicroBatcher, BatcherConfig, QueueFullError,
                       DeadlineExceededError, ShuttingDownError)
 from .metrics import ServingMetrics, SLOTracker
@@ -39,12 +42,23 @@ class ServerConfig:
     request-latency histogram is folded into a
     `slo_burn_rate{model=model_name}` gauge surfaced in /metrics and
     /healthz (docs/SERVING.md has the burn contract).  slo_ms=None
-    (the default) disables SLO tracking entirely."""
+    (the default) disables SLO tracking entirely.
+
+    tail_slow_ms / tail_capacity bound the tail recorder: requests
+    slower than tail_slow_ms (default: slo_ms) or answered >= 500 keep
+    their full span tree, retrievable via GET /debug/tail and
+    `obs_dump --tail` (docs/SERVING.md request-tracing contract).
+
+    access_log: path of an opt-in JSONL access log — one line per
+    request (request_id, trace_id, status, latency_ms, batch, bucket).
+    None (the default) logs nothing; the HTTP handler's own
+    log_message stays quiet either way."""
 
     def __init__(self, host="127.0.0.1", port=8500, max_batch=32,
                  max_wait_ms=5.0, queue_size=64, default_timeout_ms=None,
                  warmup=True, slo_ms=None, slo_target=0.99,
-                 model_name="default"):
+                 model_name="default", tail_slow_ms=None,
+                 tail_capacity=64, access_log=None):
         self.host = host
         self.port = int(port)
         self.max_batch = int(max_batch)
@@ -55,6 +69,10 @@ class ServerConfig:
         self.slo_ms = None if slo_ms is None else float(slo_ms)
         self.slo_target = float(slo_target)
         self.model_name = str(model_name)
+        self.tail_slow_ms = (self.slo_ms if tail_slow_ms is None
+                             else float(tail_slow_ms))
+        self.tail_capacity = int(tail_capacity)
+        self.access_log = access_log
 
 
 def _to_list(arr):
@@ -81,22 +99,40 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _reply(self, status, body, content_type="application/json"):
+    def _reply(self, status, body, content_type="application/json",
+               headers=None):
         data = (json.dumps(body) if content_type == "application/json"
                 else body).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(data)
 
     def do_GET(self):
         owner = self.server.owner
         if self.path == "/metrics":
-            self._reply(200, owner.metrics.render_text(),
-                        content_type="text/plain; version=0.0.4")
+            # exemplars are OpenMetrics-only syntax: a stock 0.0.4
+            # text scraper would reject the whole exposition, so they
+            # render only when the scraper negotiates the format
+            want_om = "application/openmetrics-text" in \
+                (self.headers.get("Accept") or "")
+            if want_om:
+                self._reply(
+                    200,
+                    owner.metrics.render_text(exemplars=True)
+                    + "# EOF\n",
+                    content_type="application/openmetrics-text; "
+                                 "version=1.0.0; charset=utf-8")
+            else:
+                self._reply(200, owner.metrics.render_text(),
+                            content_type="text/plain; version=0.0.4")
         elif self.path == "/healthz":
             self._reply(200, owner.health_signals())
+        elif self.path == "/debug/tail":
+            self._reply(200, owner.tail.to_dict())
         else:
             self._reply(404, {"error": "not found"})
 
@@ -105,14 +141,22 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path not in ("/v1/infer", "/infer"):
             self._reply(404, {"error": "not found"})
             return
+        # mint/continue the trace context BEFORE parsing: even a 400
+        # reply carries a request_id, and the traceparent echo tells
+        # the caller which trace to quote when filing the failure
+        ctx = obs_context.new_context(self.headers.get("traceparent"))
+        echo = {"traceparent": ctx.traceparent(),
+                "x-request-id": ctx.request_id}
         try:
             length = int(self.headers.get("Content-Length", "0"))
             payload = json.loads(self.rfile.read(length) or b"{}")
         except (ValueError, TypeError) as exc:
-            self._reply(400, {"error": "bad json: %s" % exc})
+            self._reply(400, {"error": "bad json: %s" % exc,
+                              "request_id": ctx.request_id},
+                        headers=echo)
             return
-        status, body = owner.handle_infer(payload)
-        self._reply(status, body)
+        status, body = owner.handle_infer(payload, ctx=ctx)
+        self._reply(status, body, headers=echo)
 
 
 class InferenceServer:
@@ -136,9 +180,18 @@ class InferenceServer:
                     else SLOTracker(self.metrics, self.config.slo_ms,
                                     target=self.config.slo_target,
                                     model=self.config.model_name))
+        # always-on, bounded, capture-on-slow/error: the ring costs a
+        # few KB and only tail-worthy requests write into it
+        self.tail = obs_tail.TailRecorder(
+            capacity=self.config.tail_capacity,
+            slow_ms=self.config.tail_slow_ms)
         self.draining = False
         self._httpd = None
         self._http_thread = None
+        self._access_log = None
+        self._access_lock = threading.Lock()
+        if self.config.access_log:
+            self._access_log = open(self.config.access_log, "a")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -170,6 +223,12 @@ class InferenceServer:
             self._httpd.shutdown()
             self._http_thread.join(timeout=timeout)
             self._httpd.server_close()
+        with self._access_lock:
+            # None-check inside the lock: concurrent shutdowns (signal
+            # handler + drain) must not double-close
+            if self._access_log is not None:
+                self._access_log.close()
+                self._access_log = None
 
     def health_signals(self):
         """The /healthz body: registry-derived liveness signals instead
@@ -245,31 +304,104 @@ class InferenceServer:
                 "input %r has per-sample shape %s, model expects %s"
                 % (name, list(tail), want))
 
-    def handle_infer(self, payload):
-        """(status, json body) for one inference payload — shared by
-        the HTTP handler and in-process callers/tests."""
-        if self.draining:
-            self.metrics.rejected_draining.inc()
-            return 503, {"error": "draining"}
+    def _write_access_log(self, ctx, status, latency_ms, batch, bucket):
+        """One JSONL line per request (opt-in, ServerConfig.access_log).
+        A logging failure must never fail the request."""
+        log = self._access_log
+        if log is None:
+            return
+        line = json.dumps({
+            "t": round(time.time(), 3),
+            "request_id": ctx.request_id,
+            "trace_id": ctx.trace_id,
+            "status": status,
+            "latency_ms": round(latency_ms, 3),
+            "batch": batch,
+            "bucket": bucket,
+        }, sort_keys=True)
         try:
-            feeds = self._parse_inputs(payload)
-            timeout_ms = payload.get("timeout_ms")
-            outs = self.batcher.submit_and_wait(feeds,
-                                                timeout_ms=timeout_ms)
-        except QueueFullError as exc:
-            return 429, {"error": str(exc)}
-        except DeadlineExceededError as exc:
-            return 504, {"error": str(exc)}
-        except ShuttingDownError as exc:
-            return 503, {"error": str(exc)}
-        except (ValueError, KeyError, TypeError) as exc:
-            return 400, {"error": str(exc)}
-        except Exception as exc:  # noqa: BLE001 — server must answer
-            from ..obs import flight as obs_flight
+            with self._access_lock:
+                if self._access_log is not None:
+                    self._access_log.write(line + "\n")
+                    self._access_log.flush()
+        except (OSError, ValueError):
+            pass
 
-            obs_flight.on_crash(exc, origin="serving/http")
-            return 500, {"error": "%s: %s" % (type(exc).__name__, exc)}
-        outputs = {name: _jsonable(val) for name, val in
-                   zip(self.engine.fetch_names, outs)}
-        return 200, {"outputs": outputs,
-                     "batch": self.engine.batch_size(feeds)}
+    def handle_infer(self, payload, ctx=None):
+        """(status, json body) for one inference payload — shared by
+        the HTTP handler and in-process callers/tests.
+
+        Every reply body carries the minted `request_id` — including
+        the 429/503/504 rejection bodies, so a shed request is still
+        quotable in a support ticket.  The request's span tree
+        (admission → queue wait → batch assembly → pad/bucket →
+        device execute → split) accumulates on `ctx`; slow/errored
+        requests keep theirs in the tail ring (GET /debug/tail)."""
+        if ctx is None:
+            ctx = obs_context.new_context()
+        t0 = time.perf_counter()
+        wall0 = time.time()
+        batch = bucket = None
+        error = None
+        # drain-shed replies are 503s but NOT tail-worthy: a drain
+        # under load would otherwise churn hundreds of empty span
+        # trees through the bounded ring, evicting the pre-drain
+        # slow/5xx captures an operator actually wants to read
+        tail_capture = True
+        with obs_context.use(ctx):
+            if self.draining:
+                self.metrics.rejected_draining.inc()
+                status, body = 503, {"error": "draining"}
+                tail_capture = False
+            else:
+                try:
+                    with obs_context.span("serving/admission",
+                                          cat="serving"):
+                        feeds = self._parse_inputs(payload)
+                        batch = self.engine.batch_size(feeds)
+                        cfg = getattr(self.engine, "config", None)
+                        bucket = (cfg.bucket_for(batch)
+                                  if cfg is not None else None)
+                    timeout_ms = payload.get("timeout_ms")
+                    outs = self.batcher.submit_and_wait(
+                        feeds, timeout_ms=timeout_ms, ctx=ctx)
+                    with obs_context.span("serving/serialize",
+                                          cat="serving"):
+                        outputs = {name: _jsonable(val) for name, val in
+                                   zip(self.engine.fetch_names, outs)}
+                    status, body = 200, {"outputs": outputs,
+                                         "batch": batch}
+                except QueueFullError as exc:
+                    status, body, error = 429, {"error": str(exc)}, exc
+                    # same churn argument as the drain 503s below: a
+                    # sustained overload sheds hundreds of 429s whose
+                    # empty trees would evict the captures that matter
+                    tail_capture = False
+                except DeadlineExceededError as exc:
+                    status, body, error = 504, {"error": str(exc)}, exc
+                except ShuttingDownError as exc:
+                    status, body, error = 503, {"error": str(exc)}, exc
+                    tail_capture = False
+                except (ValueError, KeyError, TypeError) as exc:
+                    status, body = 400, {"error": str(exc)}
+                except Exception as exc:  # noqa: BLE001 — must answer
+                    from ..obs import flight as obs_flight
+
+                    obs_flight.on_crash(exc, origin="serving/http",
+                                        request_id=ctx.request_id,
+                                        trace_id=ctx.trace_id)
+                    status, body, error = 500, {
+                        "error": "%s: %s" % (type(exc).__name__, exc)}, \
+                        exc
+        dur_s = time.perf_counter() - t0
+        # the request's root span, closing the tree
+        ctx.record("serving/request", wall0, dur_s,
+                   span_id=ctx.span_id,
+                   parent_span_id=ctx.parent_span_id, cat="serving",
+                   args={"status": status, "batch": batch})
+        latency_ms = dur_s * 1e3
+        if tail_capture:
+            self.tail.offer(ctx, latency_ms, status=status, error=error)
+        self._write_access_log(ctx, status, latency_ms, batch, bucket)
+        body["request_id"] = ctx.request_id
+        return status, body

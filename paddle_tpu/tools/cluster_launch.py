@@ -23,7 +23,8 @@ import signal
 import subprocess
 import sys
 
-__all__ = ["launch", "launch_remote", "stop_remote", "main"]
+__all__ = ["launch", "launch_remote", "stop_remote",
+           "print_fleet_view", "main"]
 
 
 def launch(script_argv, pservers, trainers, sync=True, env=None,
@@ -53,6 +54,11 @@ def launch(script_argv, pservers, trainers, sync=True, env=None,
         master = native.Master()
         base_env["PADDLE_MASTER"] = "127.0.0.1:%d" % master.port
         base_env["PADDLE_PSERVER_COUNT"] = str(len(pservers))
+        # fleet observability rides the same master: workers that call
+        # distributed.init_multihost (or start_fleet_reporter) publish
+        # registry snapshots under /obs/<host>, and the launcher
+        # prints the aggregated per-host view after the job
+        base_env["PADDLE_OBS_MASTER"] = base_env["PADDLE_MASTER"]
         code = (
             "import os,signal;"
             "from paddle_tpu import native;"
@@ -97,8 +103,35 @@ def launch(script_argv, pservers, trainers, sync=True, env=None,
         tr_procs.append(subprocess.Popen(
             [python] + list(script_argv),
             env={**base_env, "TRAINING_ROLE": "TRAINER",
-                 "TRAINER_ID": str(tid)}))
+                 "TRAINER_ID": str(tid),
+                 "PADDLE_FLEET_HOST": "trainer%d" % tid}))
     return ps_procs, tr_procs, master
+
+
+def print_fleet_view(master, out=sys.stdout):
+    """Aggregate whatever /obs/<host> snapshots the job's workers
+    published into the master's lease store and print the host-labeled
+    view + straggler report (obs.fleet).  Quietly a no-op when no
+    worker reported."""
+    from ..obs.fleet import FleetAggregator
+
+    agg = FleetAggregator()
+    try:
+        n = agg.collect("127.0.0.1:%d" % master.port)
+    except Exception as exc:  # noqa: BLE001 — an observability
+        # printout must never turn a successful job into a failed
+        # launcher exit (list_prefix buffer overflow, corrupt
+        # snapshot, master already gone)
+        out.write("[cluster] fleet view unavailable: %s\n" % exc)
+        return None
+    if not n:
+        return None
+    report = agg.stragglers()
+    out.write(agg.render_text())
+    out.write("[cluster] fleet: %d host snapshot(s), step_ms=%s, "
+              "stragglers=%s\n"
+              % (n, report["step_ms"], report["flagged"] or "none"))
+    return report
 
 
 def _pserver_code(wait):
@@ -257,6 +290,9 @@ def main(argv=None):
     try:
         for p in tr_procs:
             rc |= p.wait()
+        if master is not None:
+            # before pservers stop: their /obs/ leases are still live
+            print_fleet_view(master)
     finally:
         if args.hosts:
             for p in ps_procs:

@@ -1,0 +1,21 @@
+"""Fleet metric aggregation CLI ("pfleet") — thin entry point over
+`paddle_tpu.obs.fleet` (the module itself is imported by the obs
+package, so `-m` must target this wrapper to avoid the runpy
+double-import).
+
+    # worker: publish this process's registry snapshot
+    python -m paddle_tpu.tools.fleet_cli --push --master 127.0.0.1:7164
+
+    # operator: the merged host-labeled view + straggler report
+    python -m paddle_tpu.tools.fleet_cli --aggregate \
+        --master 127.0.0.1:7164
+
+See docs/OBSERVABILITY.md "Fleet aggregation & stragglers".
+"""
+
+import sys
+
+from ..obs.fleet import main
+
+if __name__ == "__main__":
+    sys.exit(main())

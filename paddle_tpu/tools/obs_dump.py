@@ -7,6 +7,9 @@
     # pretty-print a crash flight bundle (obs.flight):
     python -m paddle_tpu.tools.obs_dump --flight flight_1234_001.json
 
+    # pretty-print a tail-capture dump (obs.tail / GET /debug/tail):
+    python -m paddle_tpu.tools.obs_dump --tail tail.json
+
     # the CI entry point (scripts/ci.sh, scripts/smoke.sh):
     python -m paddle_tpu.tools.obs_dump --selftest
 
@@ -20,13 +23,16 @@
 
 `--selftest` runs a tiny REAL workload under tracing — a v2 SGD
 trainer (executor underneath), a serving InferenceEngine request pair
-(compile miss + cache hit), and a deliberately-NaN health/flight leg
-(NumericsMonitor counts, locate_nonfinite names the op, an induced
-crash writes a flight bundle) — then asserts the exported trace is
-valid Chrome trace-event JSON with nested executor/trainer spans,
-that ONE registry render carries executor, trainer and serving
-metrics, and that the per-segment xla_* memory/cost gauges landed.
-See docs/OBSERVABILITY.md for naming conventions.
+(compile miss + cache hit), a request-tracing leg (loopback server:
+traceparent continued + request_id echoed incl. on an error reply, an
+injected-slow request's exemplar in /metrics and its span tree in the
+tail ring), and a deliberately-NaN health/flight leg (NumericsMonitor
+counts, locate_nonfinite names the op, an induced crash writes a
+flight bundle) — then asserts the exported trace is valid Chrome
+trace-event JSON with nested executor/trainer spans, that ONE
+registry render carries executor, trainer and serving metrics, and
+that the per-segment xla_* memory/cost gauges landed.  See
+docs/OBSERVABILITY.md for naming conventions.
 """
 
 import argparse
@@ -53,6 +59,10 @@ def parse_args(argv=None):
     p.add_argument("--flight", default=None, metavar="BUNDLE_JSON",
                    help="validate and pretty-print a flight-recorder "
                         "bundle (obs.flight) and exit")
+    p.add_argument("--tail", default=None, metavar="TAIL_JSON",
+                   help="validate and pretty-print a tail-capture "
+                        "dump (obs.tail / the server's /debug/tail "
+                        "body) and exit")
     p.add_argument("--selftest", action="store_true",
                    help="run a tiny traced workload and assert the "
                         "whole obs pipeline works end to end")
@@ -85,12 +95,22 @@ def validate_chrome_trace(doc):
 
 def validate_prometheus_text(text):
     """Assert every exposition line parses as comment or
-    `name[{labels}] value`; returns the set of metric names seen."""
+    `name[{labels}] value[ # {exemplar} value ts]` (the bracketed
+    suffix is OpenMetrics exemplar syntax on histogram buckets);
+    returns the set of metric names seen."""
     names = set()
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
-        body, _, value = line.rpartition(" ")
+        sample, _, exemplar = line.partition(" # ")
+        if exemplar:
+            labels, _, rest = exemplar.partition("} ")
+            assert labels.startswith("{"), "bad exemplar: %r" % line
+            ex_value, _, ex_ts = rest.partition(" ")
+            float(ex_value)
+            if ex_ts:
+                float(ex_ts)
+        body, _, value = sample.rpartition(" ")
         assert body, "unparseable line: %r" % line
         float(value)  # raises if the sample value isn't numeric
         name = body.split("{", 1)[0]
@@ -129,6 +149,11 @@ def render_flight(doc, max_steps=8):
     lines.append("flight bundle v%d  reason=%s  steps=%d (%d dropped)"
                  % (doc["version"], doc.get("reason"),
                     len(doc["steps"]), doc.get("dropped_steps", 0)))
+    ctx = doc.get("trace_context")
+    if ctx:
+        lines.append("request: id=%s trace=%s span=%s"
+                     % (ctx.get("request_id"), ctx.get("trace_id"),
+                        ctx.get("span_id")))
     exc = doc.get("exception")
     if exc:
         lines.append("exception: %s: %s" % (exc["type"], exc["message"]))
@@ -162,6 +187,55 @@ def render_flight(doc, max_steps=8):
     for k, v in interesting.items():
         lines.append("  %s = %g" % (k, v))
     lines.append("recent spans: %d" % len(doc.get("recent_spans", [])))
+    return "\n".join(lines)
+
+
+def validate_tail_dump(doc):
+    """Assert `doc` (dict or path) is a well-formed tail-capture dump
+    (obs.tail.TailRecorder.dump / the /debug/tail body); returns the
+    loaded dict."""
+    if not isinstance(doc, dict):
+        with open(doc) as f:
+            doc = json.load(f)
+    assert doc.get("kind") == "paddle_tpu.tail", \
+        "not a tail dump (kind=%r)" % doc.get("kind")
+    assert isinstance(doc.get("version"), int)
+    assert isinstance(doc.get("requests"), list)
+    for rec in doc["requests"]:
+        assert rec.get("reason") in ("slow", "error"), rec
+        assert "trace_id" in rec and "request_id" in rec, rec
+        assert isinstance(rec.get("latency_ms"), (int, float)), rec
+        assert isinstance(rec.get("spans"), list), rec
+    return doc
+
+
+def _render_span_node(node, depth, lines):
+    args = node.get("args") or {}
+    arg_str = "" if not args else "  %s" % args
+    lines.append("  %s%s %.3fms%s"
+                 % ("  " * depth, node["name"],
+                    node.get("dur_ms", 0.0), arg_str))
+    for child in node.get("children", []):
+        _render_span_node(child, depth + 1, lines)
+
+
+def render_tail(doc, max_requests=8):
+    """Human-readable summary of a tail dump (the --tail CLI output):
+    one block per captured request with its indented span tree."""
+    doc = validate_tail_dump(doc)
+    lines = ["tail dump v%d  slow_ms=%s  captured=%d (%d evicted)"
+             % (doc["version"], doc.get("slow_ms"),
+                doc.get("total_captured", len(doc["requests"])),
+                doc.get("evicted", 0))]
+    for rec in doc["requests"][-max_requests:]:
+        head = ("request %s  trace %s  %s  %.1fms  status=%s"
+                % (rec["request_id"], rec["trace_id"], rec["reason"],
+                   rec["latency_ms"], rec.get("status")))
+        if rec.get("error"):
+            head += "  error=%s" % rec["error"]
+        lines.append(head)
+        for root in rec["spans"]:
+            _render_span_node(root, 0, lines)
     return "\n".join(lines)
 
 
@@ -242,6 +316,127 @@ def _serve_tiny():
     return metrics
 
 
+def _trace_serve_tiny(workdir):
+    """The request-tracing contract end to end over a REAL loopback
+    server (docs/SERVING.md): a traceparent header is continued and
+    echoed with a minted request_id (also on an error reply), a
+    deterministically-injected slow request leaves an OpenMetrics
+    exemplar carrying its trace id on the /metrics latency histogram,
+    and the tail ring keeps that request's full span tree — rendered
+    by this CLI's own --tail path."""
+    import http.client
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.fluid import io as fluid_io
+    from paddle_tpu.resilience import faults as r_faults
+    from paddle_tpu.serving import (InferenceEngine, EngineConfig,
+                                    InferenceServer, ServerConfig)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data(name="img", shape=[8], dtype="float32")
+        probs = fluid.layers.fc(input=img, size=3, act="softmax")
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    program = fluid_io.prune_program(main_prog, [probs])
+    engine = InferenceEngine(program, ["img"], [probs], scope=scope,
+                             config=EngineConfig(batch_buckets=[2]))
+    server = InferenceServer(engine, ServerConfig(
+        port=0, tail_slow_ms=50.0)).start()
+    host, port = server.address
+
+    def post(payload, headers=None):
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.request("POST", "/v1/infer", json.dumps(payload),
+                         dict({"Content-Type": "application/json"},
+                              **(headers or {})))
+            resp = conn.getresponse()
+            return (resp.status, json.loads(resp.read()),
+                    dict(resp.getheaders()))
+        finally:
+            conn.close()
+
+    def get(path, headers=None):
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.request("GET", path, headers=headers or {})
+            resp = conn.getresponse()
+            return resp.status, resp.read().decode()
+        finally:
+            conn.close()
+
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+    traceparent = "00-%s-b7ad6b7169203331-01" % trace_id
+    # the SLOW request gets its OWN trace id: the exemplar/tail
+    # assertions below must not be satisfiable by the fast request
+    slow_trace_id = "deadbeefcafe43dd8448eb211c80319c"
+    slow_traceparent = "00-%s-b7ad6b7169203331-01" % slow_trace_id
+    payload = {"inputs": {"img": [[0.5] * 8]}}
+    try:
+        # contract 1: traceparent continued + request_id minted/echoed
+        status, body, headers = post(payload,
+                                     {"traceparent": traceparent})
+        assert status == 200 and body.get("request_id"), body
+        assert headers.get("traceparent", "").split("-")[1] \
+            == trace_id, headers
+        assert headers.get("x-request-id") == body["request_id"]
+
+        # contract 2: an injected-slow request (deterministic fault,
+        # not a sleep race) leaves an exemplar + a tail capture
+        plan = r_faults.enable(seed=0)
+        plan.inject("serving/run", "latency", latency_s=0.12, times=1)
+        try:
+            status, _, _ = post(payload,
+                                {"traceparent": slow_traceparent})
+            assert status == 200
+        finally:
+            r_faults.disable()
+
+        # exemplars render only on a negotiated OpenMetrics scrape;
+        # a plain 0.0.4 scrape must stay free of the suffix syntax
+        _, plain_text = get("/metrics")
+        validate_prometheus_text(plain_text)
+        assert not any(" # " in line
+                       for line in plain_text.splitlines()), \
+            "plain text-format scrape leaked OpenMetrics exemplars"
+        _, metrics_text = get(
+            "/metrics",
+            {"Accept": "application/openmetrics-text"})
+        validate_prometheus_text(metrics_text)
+        assert any("serving_total_seconds_bucket" in line
+                   and " # " in line and slow_trace_id in line
+                   for line in metrics_text.splitlines()), \
+            "no latency-bucket exemplar carries the slow request's " \
+            "trace id"
+
+        tail_path = os.path.join(workdir, "tail.json")
+        server.tail.dump(tail_path)
+        rendered = render_tail(tail_path)
+        for needed in ("serving/queue_wait", "serving/device_execute",
+                       slow_trace_id):
+            assert needed in rendered, \
+                "%s missing from --tail render:\n%s" % (needed,
+                                                        rendered)
+        status, tail_body = get("/debug/tail")
+        assert status == 200 and \
+            validate_tail_dump(json.loads(tail_body))["requests"]
+
+        # contract 3: error replies still carry the request_id
+        server.draining = True
+        status, body, _ = post(payload)
+        server.draining = False
+        assert status == 503 and body.get("request_id"), body
+        error_request_id = body["request_id"]
+    finally:
+        server.shutdown()
+    return {"trace_id": slow_trace_id, "tail_path": tail_path,
+            "error_request_id": error_request_id}
+
+
 def _health_flight_tiny(workdir):
     """The diagnosis loop end to end: a deliberately-NaN step makes the
     NumericsMonitor count, locate_nonfinite names the offending op, and
@@ -316,6 +511,7 @@ def selftest(args):
     try:
         _train_tiny_v2()
         metrics = _serve_tiny()
+        tracing_report = _trace_serve_tiny(workdir)
         health_report, flight_bundle = _health_flight_tiny(workdir)
     finally:
         pt_flags.set_flag("xla_cost_attribution", attr_prev)
@@ -375,11 +571,15 @@ def selftest(args):
     print("[obs] selftest green: %d trace events (%d trainer steps, "
           "%d executor runs, %d jit segments, %d serving spans), "
           "unified /metrics has %d metric families, xla gauges %s, "
-          "first nonfinite op %r, flight bundle at %s, trace at %s"
+          "first nonfinite op %r, flight bundle at %s, trace at %s; "
+          "tracing leg: exemplar trace %s in /metrics, tail dump at "
+          "%s, error reply request_id %s"
           % (len(events), len(steps), len(runs), len(segs),
              len(serving_spans), len(names),
              ",".join(xla_gauges) or "n/a",
-             health_report["op_type"], flight_bundle, trace_path),
+             health_report["op_type"], flight_bundle, trace_path,
+             tracing_report["trace_id"], tracing_report["tail_path"],
+             tracing_report["error_request_id"]),
           flush=True)
     return 0
 
@@ -408,9 +608,13 @@ def main(argv=None):
     if args.flight:
         print(render_flight(args.flight), flush=True)
         return 0
+    if args.tail:
+        print(render_tail(args.tail), flush=True)
+        return 0
     if not args.trace_out and not args.metrics_out:
         raise SystemExit("nothing to do: pass --selftest, --check, "
-                         "--flight, --trace-out and/or --metrics-out")
+                         "--flight, --tail, --trace-out and/or "
+                         "--metrics-out")
     from paddle_tpu.obs import registry as obs_registry
     from paddle_tpu.obs import trace as obs_trace
 

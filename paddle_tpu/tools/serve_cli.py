@@ -51,6 +51,15 @@ def parse_args(argv=None):
                         "within --slo_ms")
     p.add_argument("--model_name", default="default",
                    help="model label on the slo_burn_rate gauge")
+    p.add_argument("--tail_slow_ms", type=float, default=None,
+                   help="keep the full span tree of requests slower "
+                        "than this (default: --slo_ms) or answered "
+                        ">=500 — GET /debug/tail, obs_dump --tail")
+    p.add_argument("--tail_capacity", type=int, default=64,
+                   help="tail-capture ring bound")
+    p.add_argument("--access_log", default=None,
+                   help="opt-in JSONL access log path (request_id, "
+                        "trace_id, status, latency_ms, batch, bucket)")
     p.add_argument("--selftest", action="store_true",
                    help="serve a built-in tiny model, fire one "
                         "request, scrape /metrics, drain, exit")
@@ -79,7 +88,10 @@ def _serve(engine, args, ready=None):
         max_wait_ms=args.max_wait_ms, queue_size=args.queue_size,
         default_timeout_ms=args.timeout_ms,
         warmup=not args.no_warmup, slo_ms=args.slo_ms,
-        slo_target=args.slo_target, model_name=args.model_name))
+        slo_target=args.slo_target, model_name=args.model_name,
+        tail_slow_ms=args.tail_slow_ms,
+        tail_capacity=args.tail_capacity,
+        access_log=args.access_log))
     server.start()
     host, port = server.address
     print("[serve] listening on http://%s:%d (feeds=%s fetches=%s "

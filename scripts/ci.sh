@@ -28,7 +28,7 @@ RUN_EXAMPLES=1 python -m pytest tests/ -q
 echo "[ci] serving selftest (server up, one request, /metrics, drain) ..."
 timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
 
-echo "[ci] obs selftest (traced train+serve, NaN health+flight loop, Perfetto JSON, unified /metrics) ..."
+echo "[ci] obs selftest (traced train+serve, request tracing: traceparent/request_id/exemplar/tail ring, NaN health+flight loop, Perfetto JSON, unified /metrics) ..."
 timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 
 echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."

@@ -200,6 +200,29 @@ def _persist_skip(name, reason):
     os.replace(tmp, bench._LAST_TPU_PATH)
 
 
+def _warn_stale_platform(name, keys):
+    """Round-5 incident class, surfaced at EMIT time: a leg that
+    persisted a record the `pperf gate` would hard-fail (no
+    accelerator claimed — `*-stale`/`*-fallback`/empty platform) gets
+    a loud WARNING line in the suite log, so the operator learns the
+    window was degraded while it can still be re-run, not days later
+    at gate time."""
+    from paddle_tpu.obs import perf as obs_perf
+
+    store = _store()
+    for key in sorted(keys):
+        rec = store.get(key) or {}
+        if rec.get("skipped"):
+            continue
+        platform = rec.get("platform")
+        if obs_perf.is_stale_platform(platform):
+            print("[mega] WARNING: leg %s emitted platform-stale "
+                  "record %s (platform=%r) — no accelerator claimed; "
+                  "the pperf gate will HARD-FAIL this as a re-emit, "
+                  "re-run the leg on the real platform"
+                  % (name, key, platform), flush=True)
+
+
 def run_one_guarded(name, overrides, timeout):
     """Run one leg in a subprocess with a hard wall-clock bound
     (subprocess guard like bench.py:115's claim probe): a pathological
@@ -366,6 +389,7 @@ def main():
         if status == "ok":
             gained = _fresh_records(since) - before
             _attach_metrics(gained, blob)
+            _warn_stale_platform(name, gained)
             if gained:
                 ok += 1
                 done[name] = time.time()

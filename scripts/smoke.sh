@@ -22,7 +22,7 @@ BENCH_ITERS=1 BENCH_WARMUP=1 BENCH_BATCH=4 BENCH_IMAGE_SIZE=32 python bench.py
 echo "[smoke] serving selftest (server up, one request, /metrics, drain) ..."
 timeout 300 python -m paddle_tpu.tools.serve_cli --selftest
 
-echo "[smoke] obs selftest (traced train+serve, NaN health+flight loop, Perfetto JSON, unified /metrics) ..."
+echo "[smoke] obs selftest (traced train+serve, request tracing: traceparent/request_id/exemplar/tail ring, NaN health+flight loop, Perfetto JSON, unified /metrics) ..."
 timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 
 echo "[smoke] chaos selftest (injected I/O fault + preemption + nonfinite; auto-resume must match fault-free run) ..."

@@ -40,6 +40,7 @@ def fresh_obs():
     from paddle_tpu.obs import health as obs_health
     from paddle_tpu.obs import perf as obs_perf
     from paddle_tpu.obs import registry as obs_registry
+    from paddle_tpu.obs import tail as obs_tail
     from paddle_tpu.obs import telemetry as obs_tele
     from paddle_tpu.obs import trace as obs_trace
     from paddle_tpu.resilience import faults as r_faults
@@ -52,6 +53,7 @@ def fresh_obs():
     obs_health.disable()
     obs_flight.uninstall()
     obs_perf.uninstall()
+    obs_tail.uninstall()
     obs_tele.install_step_observer(None)
     obs_trace.disable()
     obs_trace.reset()
