@@ -46,6 +46,9 @@ _MODELS = {
     "googlenet": dict(baseline=264.83, unit="img/s",
                       infer_baseline=600.94),
     "smallnet": dict(baseline=7039.0, unit="img/s", infer_baseline=None),
+    # no reference baseline (the benchmark set has no MNIST conv row);
+    # the ptune selftest's flagship: tiny enough to measure on CPU
+    "lenet5": dict(baseline=None, unit="img/s", infer_baseline=None),
     # strongest published LSTM number: batch 256, hidden 256 on
     # K40m = 170 ms/batch -> 1506 samples/s (BASELINE.md:26);
     # compare like-for-like with BENCH_BATCH=256 BENCH_HIDDEN=256
@@ -63,15 +66,33 @@ DEFAULT_PEAK_TFLOPS_BF16 = 197.0
 DEFAULT_PEAK_TFLOPS_F32 = DEFAULT_PEAK_TFLOPS_BF16 / 2
 
 
-def _build_image_model(model, batch, image_size, class_dim):
+def _image_spec(model):
+    """Per-image-model channels/image-size/class-dim defaults.
+
+    ONE table, owned by paddle_tpu.tune.models — the tuner ranks the
+    program this file measures, so a default that drifted between two
+    hand-maintained copies would silently price one program and time
+    another."""
+    from paddle_tpu.tune.models import MODELS
+
+    return MODELS[model]
+
+
+def _image_model_fn(model):
     from paddle_tpu import models
+
+    return {"resnet50": models.resnet50, "alexnet": models.alexnet,
+            "vgg16": models.vgg16, "vgg19": models.vgg19,
+            "googlenet": models.googlenet, "lenet5": models.lenet5,
+            "smallnet": models.smallnet_mnist_cifar}[model]
+
+
+def _build_image_model(model, batch, image_size, class_dim):
     from __graft_entry__ import _build_model
 
-    fn = {"resnet50": models.resnet50, "alexnet": models.alexnet,
-          "vgg16": models.vgg16, "vgg19": models.vgg19,
-          "googlenet": models.googlenet,
-          "smallnet": models.smallnet_mnist_cifar}[model]
-    return _build_model(fn, batch, image_size, class_dim, with_loss=True)
+    return _build_model(_image_model_fn(model), batch, image_size,
+                        class_dim, with_loss=True,
+                        channels=_image_spec(model)["channels"])
 
 
 def _image_feeds(batch, image_size, class_dim, channels=3):
@@ -213,17 +234,46 @@ def _append_history(record):
               file=sys.stderr, flush=True)
 
 
-def _tagged(metric, recompute_stride=0):
+def _tagged(metric, recompute_stride=0, micro=1):
     """BENCH_TAG distinguishes variant runs of one config in the
     persisted store and the emitted metric (e.g. the
     FLAGS_fuse_optimizer=0 A/B: ...batch128+nofuse); an ACTIVE
     recompute rewrite (the effective stride, parsed once in main) tags
-    as +rcp<stride>."""
+    as +rcp<stride>, a micro-batch split as +mb<m>."""
     tag = os.environ.get("BENCH_TAG", "")
     parts = ([tag] if tag else []) + \
         (["rcp%d" % recompute_stride] if recompute_stride else []) + \
+        (["mb%d" % micro] if micro > 1 else []) + \
         (["nhwc"] if os.environ.get("BENCH_LAYOUT") == "NHWC" else [])
     return metric + "".join("+" + p for p in parts)
+
+
+def _config_blob(model, mode, batch, micro, rcp, amp_bf16, pass_spec,
+                 image_size=None):
+    """The candidate-point blob stamped into every BENCH record and
+    history line, so a tuner measurement (paddle_tpu.tune) joins back
+    to the config that produced it without filename archaeology.
+    `mesh` is the tuner's candidate mesh (BENCH_MESH) — informational
+    on a single-chip run; `pass_pipeline` is the compile-cache
+    pipeline id the FLAGS_compile_passes spec resolves to."""
+    pipeline = None
+    if pass_spec:
+        from paddle_tpu.compile.passes import pipeline_id
+
+        pipeline = pipeline_id(pass_spec) or None
+    blob = {
+        "model": model, "mode": mode, "batch": batch,
+        "micro_batches": micro,
+        "mesh": os.environ.get("BENCH_MESH") or None,
+        "pass_pipeline": pipeline,
+        "amp_bf16": amp_bf16,
+        "recompute": rcp,
+        "layout": os.environ.get("BENCH_LAYOUT", "NCHW"),
+        "tag": os.environ.get("BENCH_TAG") or None,
+    }
+    if image_size is not None:
+        blob["image_size"] = image_size
+    return blob
 
 
 def main():
@@ -255,6 +305,22 @@ def main():
         raise SystemExit("BENCH_RECOMPUTE must be >= 0")
     if mode != "train":
         rcp = 0
+    # BENCH_MICRO_BATCH=m: μ-cuDNN-style split — build the model at
+    # batch/m and run m sequential micro-steps per logical step (the
+    # memory-vs-speed knob the tuner searches; activations scale 1/m)
+    try:
+        micro = int(os.environ.get("BENCH_MICRO_BATCH", "1"))
+    except ValueError:
+        raise SystemExit("BENCH_MICRO_BATCH must be an integer split")
+    if micro < 1:
+        raise SystemExit("BENCH_MICRO_BATCH must be >= 1")
+    if micro > 1:
+        if mode != "train" or model in ("lstm", "transformer"):
+            raise SystemExit("BENCH_MICRO_BATCH supports image-model "
+                             "training")
+        if batch % micro:
+            raise SystemExit("BENCH_BATCH=%d not divisible by "
+                             "BENCH_MICRO_BATCH=%d" % (batch, micro))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS",
                                "10" if mode == "train" else "30"))
@@ -302,7 +368,7 @@ def main():
                                int(os.environ.get("BENCH_D_MODEL", "512")))
         else:
             req_metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
-        req_metric = _tagged(req_metric, rcp)
+        req_metric = _tagged(req_metric, rcp, micro)
         stale = _stale_tpu_record(model, req_metric, amp_requested)
         if stale is not None:
             print("bench: accelerator claim failed; re-emitting last "
@@ -390,30 +456,31 @@ def main():
                                 + 2 * d_model * vocab) / 1e9
         samples_per_step = batch * seq_len
     else:
-        image_size = int(os.environ.get(
-            "BENCH_IMAGE_SIZE", "32" if model == "smallnet" else "224"))
-        class_dim = int(os.environ.get(
-            "BENCH_CLASS_DIM", "10" if model == "smallnet" else "1000"))
+        img_spec = _image_spec(model)
+        image_size = int(os.environ.get("BENCH_IMAGE_SIZE",
+                                        img_spec["image_size"]))
+        class_dim = int(os.environ.get("BENCH_CLASS_DIM",
+                                       img_spec["class_dim"]))
         metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
-        feeds_np = _image_feeds(batch, image_size, class_dim)
+        # the build batch is the micro-batch slice; the logical step
+        # still processes `batch` samples (m micro-steps per step)
+        build_batch = batch // micro
+        feeds_np = _image_feeds(build_batch, image_size, class_dim,
+                                channels=img_spec["channels"])
         if mode == "infer":
-            from paddle_tpu import models as _models
             from __graft_entry__ import _build_model
 
-            model_fn = {
-                "resnet50": _models.resnet50, "alexnet": _models.alexnet,
-                "vgg16": _models.vgg16, "vgg19": _models.vgg19,
-                "googlenet": _models.googlenet,
-                "smallnet": _models.smallnet_mnist_cifar}[model]
             main_prog, startup, logits, _ = _build_model(
-                model_fn, batch, image_size, class_dim, with_loss=False)
+                _image_model_fn(model), build_batch, image_size,
+                class_dim, with_loss=False,
+                channels=img_spec["channels"])
             main_prog = main_prog.clone(for_test=True)
             avg_loss = logits
             feed_names = ["image"]
             feeds_np = {"image": feeds_np["image"]}
         else:
             main_prog, startup, _, avg_loss = _build_image_model(
-                model, batch, image_size, class_dim)
+                model, build_batch, image_size, class_dim)
             feed_names = ["image", "label"]
         # exact FLOPs from the built IR (fluid/analysis.py) rather than
         # a hand-maintained constant: fwd-only for the inference clone,
@@ -423,7 +490,7 @@ def main():
         from paddle_tpu.fluid.analysis import program_costs
 
         step_flops = sum(f for _, f, _, _ in program_costs(main_prog))
-        gflop_per_sample = step_flops / 1e9 / batch
+        gflop_per_sample = step_flops / 1e9 / build_batch
         flops_model = "ir-2flops-per-mac"
 
     # BENCH_RECOMPUTE=<stride>: rematerialize forward segments in the
@@ -435,6 +502,18 @@ def main():
             main_prog, auto_checkpoints(main_prog, every=rcp))
         print("bench: recompute stride %d cloned %d forward ops"
               % (rcp, cloned), file=sys.stderr, flush=True)
+
+    # FLAGS_compile_passes: the timed program dispatches through
+    # FunctionalProgram (not the executor), so the tuner's pass
+    # pipeline must be applied here for the measurement to cover it
+    pass_spec = pt_flags.get_flag("compile_passes")
+    if pass_spec:
+        from paddle_tpu.compile.passes import optimize_program
+
+        main_prog, _pm = optimize_program(main_prog, pass_spec,
+                                          fetches=[avg_loss.name])
+        print("bench: pass pipeline %s applied to the timed program"
+              % _pm.pipeline_id, file=sys.stderr, flush=True)
 
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
@@ -453,21 +532,36 @@ def main():
     step = jax.jit(lambda s, f: fp(s, f), donate_argnums=(0,))
     feeds = jax.device_put(feeds_np, dev)
 
-    # AOT the steady-state step and keep the artifact: one bootstrap
-    # step through the jit path first (AMP casts parameters on first
-    # touch, so the signature the timed loop actually dispatches only
-    # exists after a step), then lower THAT signature once — the same
+    # AOT the steady-state step and keep the artifact: bootstrap
+    # through the jit path until the state signature reaches its
+    # fixed point (AMP casts state tensors on first touch and the
+    # optimizer's velocity slots take one step MORE to settle — f32 ->
+    # bf16 -> f32 — so lowering after a single step pins a transient
+    # signature whose executable rejects the steady state on the
+    # second timed call), then lower THAT signature once — the same
     # executable runs the remaining warmup + timed loop AND exposes
     # XLA's whole-step memory/cost analyses for the record's perf
-    # blob.  With AMP on this costs exactly the two compiles the jit
-    # path always paid (f32 bootstrap + bf16 steady); pure-f32 runs
-    # pay one extra compile, which the jax compilation cache absorbs
-    # on accelerator runs.  BENCH_AOT=0 opts out.
+    # blob.  The bootstrap compiles are the ones the jit path always
+    # paid for the same signatures; the jax compilation cache absorbs
+    # them on accelerator runs.  BENCH_AOT=0 opts out.
     xla_stats = {}
+    # micro-batch split: m micro-steps per logical step, in both the
+    # warmup and the timed loop (timed quantity = full-batch steps)
+    warmup_steps = warmup * micro
     if warmup and os.environ.get("BENCH_AOT", "1") != "0":
-        fetches, state = step(state, feeds)
-        jax.block_until_ready(fetches)
-        warmup -= 1
+        def _sig(s):
+            return {n: (str(v.dtype), tuple(v.shape))
+                    for n, v in s.items()}
+
+        prev_sig = _sig(state)
+        for _ in range(3):
+            fetches, state = step(state, feeds)
+            jax.block_until_ready(fetches)
+            warmup_steps = max(warmup_steps - 1, 0)
+            cur_sig = _sig(state)
+            if cur_sig == prev_sig:
+                break
+            prev_sig = cur_sig
         try:
             compiled_step = step.lower(state, feeds).compile()
         except Exception as exc:  # noqa: BLE001 — never forfeit a run
@@ -480,12 +574,12 @@ def main():
                 "bench/step", compiled_step) or {}
             step = compiled_step
 
-    for _ in range(warmup):
+    for _ in range(warmup_steps):
         fetches, state = step(state, feeds)
     jax.block_until_ready(state)
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for _ in range(iters * micro):
         fetches, state = step(state, feeds)
     jax.block_until_ready(fetches)
     dt = time.perf_counter() - t0
@@ -511,8 +605,10 @@ def main():
     try:
         from paddle_tpu.obs import perf as obs_perf
 
+        # the program is the micro-batch slice, so classify its own
+        # per-micro step against its floors (micro=1: the full step)
         perf_blob = obs_perf.leg_perf_blob(
-            main_prog, dt / iters,
+            main_prog, dt / (iters * micro),
             bf16_act=amp_bf16 and pt_flags.get_flag("amp_bf16_act"),
             peak_tflops=peak_tflops,
             hbm_gbps=float(os.environ.get("BENCH_HBM_GBPS", "0"))
@@ -522,7 +618,7 @@ def main():
     except Exception as exc:  # noqa: BLE001 — a blob failure must
         print("bench: perf blob failed: %r" % (exc,),   # not eat the
               file=sys.stderr, flush=True)              # measurement
-    metric = _tagged(metric, rcp)
+    metric = _tagged(metric, rcp, micro)
     record = {
         "metric": metric,
         "value": round(samples_per_sec, 2),
@@ -539,6 +635,12 @@ def main():
         # the platform JAX actually ran on, not the requested one
         "platform": dev.platform + ("-fallback" if fallback else ""),
         "perf": perf_blob,
+        # the candidate point this record measured (tune/fit.py joins
+        # history rows back to their plan entry through this)
+        "config": _config_blob(
+            model, mode, batch, micro, rcp, amp_bf16, pass_spec,
+            image_size=None if model in ("lstm", "transformer")
+            else image_size),
     }
     if pt_flags.get_flag("compile_cache_dir"):
         # this run's persistent-executable-cache efficacy (startup

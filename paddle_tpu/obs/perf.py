@@ -16,8 +16,8 @@ XLA cost attribution), turning raw numbers into verdicts:
     `fluid/analysis.py` roofline (and, when present, the PR 7 AOT
     cost-attribution numbers) into ONE verdict per step/leg:
     `compute_bound | hbm_bound | input_bound | host_bound`, with the
-    dominant segment/op named.  This is the logic that used to be
-    hand-run through scripts/roofline.py + scripts/profile_tpu.py.
+    dominant segment/op named.  This is the logic that used to be a
+    hand-run sweep (scripts/profile_tpu.py is the per-HLO follow-up).
   * the perf history store + regression gate — bench.py/mega_bench
     append normalized records to `perf_history.jsonl`;
     `gate_history()` compares the newest run per metric against a
@@ -43,7 +43,8 @@ from . import trace as trace_mod
 __all__ = ["StepProfiler", "install", "uninstall", "get_profiler",
            "classify_split", "roofline_floors", "leg_perf_blob",
            "VERDICTS", "normalize_record", "append_history",
-           "load_history", "gate_history", "format_gate", "GateResult",
+           "load_history", "prune_stale_history", "gate_history",
+           "format_gate", "GateResult",
            "DEFAULT_TOLERANCE", "DEFAULT_BASELINE_N",
            "HISTORY_BASENAME"]
 
@@ -551,6 +552,11 @@ def normalize_record(record, leg=None, ts=None):
     cc = record.get("compile_cache")
     if cc:
         norm["compile_cache"] = cc
+    cfg = record.get("config")
+    if cfg:
+        # the candidate point (mesh/pipeline/batch/micro-batch knobs)
+        # this record measured — the tuner's join key (tune/fit.py)
+        norm["config"] = cfg
     return norm
 
 
@@ -629,6 +635,44 @@ def is_stale_platform(platform):
 
 # internal alias (pre-existing callers)
 _is_stale_platform = is_stale_platform
+
+
+def prune_stale_history(path, apply=False):
+    """Drop stale/fallback-platform records from a history file (the
+    round-5 incident class): the gate hard-fails them and the tuner's
+    calibration fit must never train on them, so once diagnosed they
+    are pure noise.  Unparsable lines are preserved as-is (same
+    conservatism as `load_history`'s torn-append tolerance).
+
+    Dry-run by default: returns (kept_count, dropped_records) without
+    touching the file; `apply=True` rewrites it atomically
+    (tmp + rename).  `pperf history --prune-stale [--yes]` is the
+    operator surface."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return 0, []
+    kept, dropped = [], []
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            kept.append(line)
+            continue
+        if isinstance(rec, dict) and \
+                is_stale_platform(rec.get("platform")):
+            dropped.append(rec)
+        else:
+            kept.append(line)
+    if apply and dropped:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("".join(l + "\n" for l in kept))
+        os.replace(tmp, path)
+    return len(kept), dropped
 
 
 def gate_history(records, baseline_n=DEFAULT_BASELINE_N,

@@ -5,9 +5,8 @@ inspection, and the noise-aware regression gate over
     # the CI entry point (scripts/ci.sh, scripts/smoke.sh):
     python -m paddle_tpu.tools.perf_cli --selftest
 
-    # roofline + bottleneck verdict for a bench model (replaces the
-    # retired scripts/roofline.py; pass --step-ms to classify a
-    # measured step against its floors):
+    # roofline + bottleneck verdict for a bench model (pass --step-ms
+    # to classify a measured step against its floors):
     PYTHONPATH= JAX_PLATFORMS=cpu python -m paddle_tpu.tools.perf_cli \
         classify --model resnet50 --batch 128 --step-ms 51.8
 
@@ -87,6 +86,14 @@ def parse_args(argv=None):
     p.add_argument("--allow-stale", action="store_true",
                    help="gate: downgrade stale-platform hard fails "
                         "to skips")
+    p.add_argument("--prune-stale", action="store_true",
+                   help="history: drop tpu-stale/cpu-fallback platform "
+                        "records from the history file (dry-run "
+                        "unless --yes) so the tuner's calibration fit "
+                        "never trains on the round-5 incident class")
+    p.add_argument("--yes", action="store_true",
+                   help="history --prune-stale: actually rewrite the "
+                        "file (atomically)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output")
     return p.parse_args(argv)
@@ -158,9 +165,32 @@ def cmd_classify(args):
 # history / gate
 # ---------------------------------------------------------------------------
 
+def _prune_stale(args):
+    from paddle_tpu.obs import perf as obs_perf
+
+    kept, dropped = obs_perf.prune_stale_history(args.history,
+                                                 apply=args.yes)
+    if not dropped:
+        print("[pperf] no stale-platform records in %s (%d kept)"
+              % (args.history, kept))
+        return 0
+    verb = "dropped" if args.yes else "would drop"
+    print("[pperf] %s %d stale-platform record(s) from %s (%d kept):"
+          % (verb, len(dropped), args.history, kept))
+    for rec in dropped:
+        print("  %-52s %-12s %s" % (rec.get("metric", "?"),
+                                    rec.get("platform", "?"),
+                                    rec.get("leg") or ""))
+    if not args.yes:
+        print("[pperf] dry run — pass --yes to rewrite the file")
+    return 0
+
+
 def cmd_history(args):
     from paddle_tpu.obs import perf as obs_perf
 
+    if args.prune_stale:
+        return _prune_stale(args)
     records = obs_perf.load_history(args.history)
     if not records:
         print("[pperf] no history at %s" % args.history)

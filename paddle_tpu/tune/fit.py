@@ -1,0 +1,208 @@
+"""History-fitted calibration of the static ranking model.
+
+The TVM lesson (PAPERS.md): an analytic cost model ranks, a LEARNED
+correction makes the ranking trustworthy — and the training data is
+free, because every measured run already lands in
+`perf_history.jsonl`.  This module joins a plan's predictions to the
+history records its measurements produced (leg `ptune:<tag>` + the
+stamped `"config"` blob), fits a per-term correction, and reports how
+wrong the model was before and after — so ranking error shrinks with
+every measured run.
+
+What gets fitted: bench.py measures a candidate's single-chip proxy
+(per-device batch slice; see tune/measure.py), so the measurable
+prediction for a record is
+
+    meas_pred = a * compute_s * n_devices / dp   (the slice's floor)
+              + b * overhead_s + bias
+
+and the least-squares fit learns (a, b, bias) — the multiplicative
+gap between roofline floors and reality, and the real dispatch cost.
+The comm term keeps its analytic ring-cost price until multi-chip
+measurement legs exist (ROADMAP item 1); its coefficient stays 1.0
+and the calibration says so in its `note`.
+
+Records with a stale/fallback platform are never trained on — the
+round-5 incident class; `pperf history --prune-stale` removes them
+from the file, and this module skips them even when it hasn't run.
+"""
+
+import math
+
+from .rank import Calibration
+
+__all__ = ["join_history", "fit_calibration", "format_fit_report",
+           "LEG_PREFIX"]
+
+LEG_PREFIX = "ptune:"
+
+
+def _plan_entries(plan):
+    """tag -> {terms (seconds), dp, n_devices} for a RankedPlan or a
+    loaded plan-JSON dict."""
+    out = {}
+    if hasattr(plan, "ranked") and not isinstance(plan, dict):
+        for e in plan.ranked:
+            c = e.candidate
+            out[c.tag()] = {"terms": dict(e.terms), "dp": c.dp,
+                            "n_devices": c.n_devices}
+        return out, getattr(plan, "model", None)
+    from ..parallel.mesh import parse_mesh_spec
+
+    for e in plan.get("ranked", ()):
+        axes = parse_mesh_spec(e["config"]["mesh"]).shape
+        n = 1
+        for s in axes.values():
+            n *= s
+        out[e["tag"]] = {
+            "terms": {"%s_s" % k: v / 1e3
+                      for k, v in e["terms_ms"].items()},
+            "dp": int(axes.get("dp", 1)), "n_devices": n,
+        }
+    return out, plan.get("model")
+
+
+def join_history(plan, records):
+    """Pair every usable `ptune:<tag>` history record with its
+    candidate's predicted terms.
+
+    Returns a list of {"tag", "measured_s", "meas_compute_s",
+    "overhead_s", "platform", "leg"} — `meas_compute_s` is the
+    compute floor of what bench actually ran (the per-device slice),
+    i.e. compute_s rescaled from 1/n_devices to 1/dp.  Stale-platform
+    records are skipped (never train on a re-emit)."""
+    from ..obs import perf as obs_perf
+
+    entries, _model = _plan_entries(plan)
+    pairs = []
+    for r in records:
+        leg = r.get("leg") or ""
+        if not leg.startswith(LEG_PREFIX):
+            continue
+        tag = leg[len(LEG_PREFIX):]
+        ent = entries.get(tag)
+        if ent is None:
+            continue
+        if obs_perf.is_stale_platform(r.get("platform")):
+            continue
+        step_ms = r.get("step_ms")
+        if not step_ms or step_ms <= 0:
+            continue
+        t = ent["terms"]
+        pairs.append({
+            "tag": tag,
+            "measured_s": float(step_ms) / 1e3,
+            "meas_compute_s": t["compute_s"] * ent["n_devices"]
+            / max(ent["dp"], 1),
+            "overhead_s": t["overhead_s"],
+            "platform": r.get("platform"),
+            "leg": leg,
+        })
+    return pairs
+
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    if not n:
+        return None
+    if n % 2:
+        return vals[n // 2]
+    return (vals[n // 2 - 1] + vals[n // 2]) / 2.0
+
+
+def _rel_error(pairs, a, b, bias):
+    """Median |predicted - measured| / measured over the pairs."""
+    errs = []
+    for p in pairs:
+        pred = a * p["meas_compute_s"] + b * p["overhead_s"] + bias
+        errs.append(abs(pred - p["measured_s"]) / p["measured_s"])
+    return _median(errs)
+
+
+def fit_calibration(pairs, model=None, prior=None):
+    """Least-squares per-term correction from measured pairs.
+
+    prior: the Calibration the `error_before` is charged against
+        (identity when None — the uncalibrated model).
+
+    Degenerate data falls back gracefully: one measurement (or a
+    singular/negative LS solution) fits a single scalar on
+    compute+overhead; zero measurements returns the prior unchanged.
+    """
+    import numpy as np
+
+    prior = prior or Calibration.identity()
+    if not pairs:
+        return prior
+    err_before = _rel_error(pairs, prior.coef["compute"],
+                            prior.coef["overhead"], prior.bias_s)
+    n = len(pairs)
+    a = b = bias = None
+    if n >= 2:
+        cols = [[p["meas_compute_s"] for p in pairs],
+                [p["overhead_s"] for p in pairs]]
+        if n >= 3:
+            cols.append([1.0] * n)
+        X = np.array(cols, dtype=np.float64).T
+        y = np.array([p["measured_s"] for p in pairs],
+                     dtype=np.float64)
+        sol, _res, _rank, _sv = np.linalg.lstsq(X, y, rcond=None)
+        sol = [float(v) for v in sol] + [0.0] * (3 - len(sol))
+        a, b, bias = sol[0], sol[1], sol[2]
+        if not all(math.isfinite(v) for v in (a, b, bias)) \
+                or a <= 0 or b < 0:
+            a = b = bias = None  # collinear/degenerate: scalar fallback
+    if a is None:
+        ratio = _median([p["measured_s"]
+                         / (p["meas_compute_s"] + p["overhead_s"])
+                         for p in pairs])
+        a = b = float(ratio)
+        bias = 0.0
+    err_after = _rel_error(pairs, a, b, bias)
+    if err_after is not None and err_before is not None \
+            and err_after > err_before:
+        # never ship a correction worse than what we had (can happen
+        # when the median metric disagrees with the LS objective)
+        a, b, bias = (prior.coef["compute"], prior.coef["overhead"],
+                      prior.bias_s)
+        err_after = err_before
+    return Calibration(
+        coef={"compute": a, "comm": prior.coef["comm"],
+              "overhead": b},
+        bias_s=bias, n=n, model=model,
+        error_before=err_before, error_after=err_after,
+        note="comm term uncalibrated: measurements are single-chip "
+             "proxies (per-device batch slice)")
+
+
+def format_fit_report(calibration, pairs):
+    """The `ptune fit`/`report` table: per-record predicted (with the
+    fitted correction) vs measured, and the before/after error."""
+    lines = ["calibration over %d measured run(s)%s:"
+             % (len(pairs),
+                (" for %s" % calibration.model)
+                if calibration.model else "")]
+    a = calibration.coef["compute"]
+    b = calibration.coef["overhead"]
+    bias = calibration.bias_s
+    lines.append("  coef: compute %.4g, overhead %.4g, comm %.4g "
+                 "(analytic), bias %.4g ms"
+                 % (a, b, calibration.coef["comm"], bias * 1e3))
+    lines.append("  %-44s %12s %12s %8s"
+                 % ("candidate", "pred ms", "measured ms", "err"))
+    for p in sorted(pairs, key=lambda p: p["tag"]):
+        pred = a * p["meas_compute_s"] + b * p["overhead_s"] + bias
+        err = abs(pred - p["measured_s"]) / p["measured_s"]
+        lines.append("  %-44s %12.3f %12.3f %7.1f%%"
+                     % (p["tag"], pred * 1e3,
+                        p["measured_s"] * 1e3, err * 100))
+    if calibration.error_before is not None:
+        lines.append(
+            "  median relative error: %.1f%% -> %.1f%% "
+            "(before -> after fit)"
+            % (calibration.error_before * 100,
+               calibration.error_after * 100))
+    if calibration.note:
+        lines.append("  note: %s" % calibration.note)
+    return "\n".join(lines)

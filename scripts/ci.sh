@@ -40,6 +40,9 @@ timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 echo "[ci] pperf selftest (gate discriminates 20% regression + tpu-stale, step profiler ring/exports, loopback SLO burn, warm pcache blob) ..."
 timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 
+echo "[ci] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, top-K measured with config blobs, calibration error shrinks) ..."
+timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
+
 echo "[ci] proglint selftest (verifier corruptions + sharding analyzer: lenet5/golden clean on 4 dryrun meshes, seeded S-code corruptions) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 

@@ -102,8 +102,10 @@ CONFIGS = [
 
 _MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",
             "BENCH_HIDDEN", "BENCH_RECOMPUTE", "BENCH_LAYOUT",
-            "BENCH_AMP", "BENCH_LEG", "FLAGS_amp_bf16_act",
-            "FLAGS_fuse_optimizer", "FLAGS_bn_shifted_stats")
+            "BENCH_AMP", "BENCH_LEG", "BENCH_MESH",
+            "BENCH_MICRO_BATCH", "FLAGS_amp_bf16_act",
+            "FLAGS_fuse_optimizer", "FLAGS_bn_shifted_stats",
+            "FLAGS_compile_passes")
 
 # legs whose single huge graph has wedged the remote compile service
 # (sweep 1: googlenet >40 min, killed): run these behind the
@@ -282,7 +284,8 @@ def run_one(name, overrides):
     os.environ.update(overrides)
     os.environ["BENCH_LEG"] = name  # names the leg in perf_history
     flags.parse_flags_from_env()
-    for k in ("amp_bf16_act", "fuse_optimizer", "bn_shifted_stats"):
+    for k in ("amp_bf16_act", "fuse_optimizer", "bn_shifted_stats",
+              "compile_passes"):
         if "FLAGS_" + k not in overrides:
             flags.set_flag(k, flags._FLAGS[k]["default"])
     amp.disable_bf16()           # bench.main re-enables unless AMP=0

@@ -34,6 +34,9 @@ timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 echo "[smoke] pperf selftest (regression gate, step profiler, SLO burn, warm pcache blob) ..."
 timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 
+echo "[smoke] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, measured top-K + calibration) ..."
+timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
+
 echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate + sharding analyzer over the 4 dryrun meshes) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
