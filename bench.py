@@ -234,22 +234,24 @@ def _append_history(record):
               file=sys.stderr, flush=True)
 
 
-def _tagged(metric, recompute_stride=0, micro=1):
+def _tagged(metric, recompute_stride=0, micro=1, prefetch=0):
     """BENCH_TAG distinguishes variant runs of one config in the
     persisted store and the emitted metric (e.g. the
     FLAGS_fuse_optimizer=0 A/B: ...batch128+nofuse); an ACTIVE
     recompute rewrite (the effective stride, parsed once in main) tags
-    as +rcp<stride>, a micro-batch split as +mb<m>."""
+    as +rcp<stride>, a micro-batch split as +mb<m>, a device-prefetch
+    input pipeline as +pf<depth>."""
     tag = os.environ.get("BENCH_TAG", "")
     parts = ([tag] if tag else []) + \
         (["rcp%d" % recompute_stride] if recompute_stride else []) + \
         (["mb%d" % micro] if micro > 1 else []) + \
+        (["pf%d" % prefetch] if prefetch else []) + \
         (["nhwc"] if os.environ.get("BENCH_LAYOUT") == "NHWC" else [])
     return metric + "".join("+" + p for p in parts)
 
 
 def _config_blob(model, mode, batch, micro, rcp, amp_bf16, pass_spec,
-                 image_size=None):
+                 image_size=None, prefetch=0):
     """The candidate-point blob stamped into every BENCH record and
     history line, so a tuner measurement (paddle_tpu.tune) joins back
     to the config that produced it without filename archaeology.
@@ -268,6 +270,7 @@ def _config_blob(model, mode, batch, micro, rcp, amp_bf16, pass_spec,
         "pass_pipeline": pipeline,
         "amp_bf16": amp_bf16,
         "recompute": rcp,
+        "prefetch": prefetch,
         "layout": os.environ.get("BENCH_LAYOUT", "NCHW"),
         "tag": os.environ.get("BENCH_TAG") or None,
     }
@@ -321,6 +324,20 @@ def main():
         if batch % micro:
             raise SystemExit("BENCH_BATCH=%d not divisible by "
                              "BENCH_MICRO_BATCH=%d" % (batch, micro))
+    # BENCH_PREFETCH=depth: feed every step through an async
+    # device-prefetch reader (reader/prefetch.device_prefetch) instead
+    # of a pinned device-resident constant — a worker thread prepares
+    # and device_puts the NEXT batch while the current step runs.
+    # This is the lever for input-bound verdicts (AlexNet at 14% MFU):
+    # the measurement finally includes a per-step H2D input cost, and
+    # the prefetch depth is what hides it.  0 (default) keeps the old
+    # device-resident-feeds loop.
+    try:
+        prefetch = int(os.environ.get("BENCH_PREFETCH", "0"))
+    except ValueError:
+        raise SystemExit("BENCH_PREFETCH must be an integer depth")
+    if prefetch < 0:
+        raise SystemExit("BENCH_PREFETCH must be >= 0")
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     iters = int(os.environ.get("BENCH_ITERS",
                                "10" if mode == "train" else "30"))
@@ -368,7 +385,7 @@ def main():
                                int(os.environ.get("BENCH_D_MODEL", "512")))
         else:
             req_metric = "%s_%s_imgs_per_sec_batch%d" % (model, mode, batch)
-        req_metric = _tagged(req_metric, rcp, micro)
+        req_metric = _tagged(req_metric, rcp, micro, prefetch)
         stale = _stale_tpu_record(model, req_metric, amp_requested)
         if stale is not None:
             print("bench: accelerator claim failed; re-emitting last "
@@ -530,7 +547,23 @@ def main():
     state[RNG_STATE_NAME] = jax.device_put(jax.random.PRNGKey(0), dev)
 
     step = jax.jit(lambda s, f: fp(s, f), donate_argnums=(0,))
-    feeds = jax.device_put(feeds_np, dev)
+    if prefetch:
+        from paddle_tpu.reader.prefetch import device_prefetch
+
+        def _batches():
+            while True:
+                yield feeds_np
+
+        _feed_iter = iter(device_prefetch(_batches, place=None,
+                                          depth=prefetch)())
+
+        def next_feeds():
+            return next(_feed_iter)
+    else:
+        feeds = jax.device_put(feeds_np, dev)
+
+        def next_feeds():
+            return feeds
 
     # AOT the steady-state step and keep the artifact: bootstrap
     # through the jit path until the state signature reaches its
@@ -555,7 +588,7 @@ def main():
 
         prev_sig = _sig(state)
         for _ in range(3):
-            fetches, state = step(state, feeds)
+            fetches, state = step(state, next_feeds())
             jax.block_until_ready(fetches)
             warmup_steps = max(warmup_steps - 1, 0)
             cur_sig = _sig(state)
@@ -563,7 +596,7 @@ def main():
                 break
             prev_sig = cur_sig
         try:
-            compiled_step = step.lower(state, feeds).compile()
+            compiled_step = step.lower(state, next_feeds()).compile()
         except Exception as exc:  # noqa: BLE001 — never forfeit a run
             print("bench: AOT lowering failed (%r); staying on jit "
                   "dispatch" % (exc,), file=sys.stderr, flush=True)
@@ -575,12 +608,12 @@ def main():
             step = compiled_step
 
     for _ in range(warmup_steps):
-        fetches, state = step(state, feeds)
+        fetches, state = step(state, next_feeds())
     jax.block_until_ready(state)
 
     t0 = time.perf_counter()
     for _ in range(iters * micro):
-        fetches, state = step(state, feeds)
+        fetches, state = step(state, next_feeds())
     jax.block_until_ready(fetches)
     dt = time.perf_counter() - t0
 
@@ -618,7 +651,7 @@ def main():
     except Exception as exc:  # noqa: BLE001 — a blob failure must
         print("bench: perf blob failed: %r" % (exc,),   # not eat the
               file=sys.stderr, flush=True)              # measurement
-    metric = _tagged(metric, rcp, micro)
+    metric = _tagged(metric, rcp, micro, prefetch)
     record = {
         "metric": metric,
         "value": round(samples_per_sec, 2),
@@ -640,7 +673,7 @@ def main():
         "config": _config_blob(
             model, mode, batch, micro, rcp, amp_bf16, pass_spec,
             image_size=None if model in ("lstm", "transformer")
-            else image_size),
+            else image_size, prefetch=prefetch),
     }
     if pt_flags.get_flag("compile_cache_dir"):
         # this run's persistent-executable-cache efficacy (startup

@@ -42,7 +42,25 @@ from .common import EMPTY, resolve_op_info
 from .diagnostics import Diagnostic, Report, Severity
 
 __all__ = ["Liveness", "analyze_block", "analyze_dataflow",
-           "dead_op_indices"]
+           "dead_op_indices", "liveness_peak_bytes"]
+
+
+def liveness_peak_bytes(op_descs, var_bytes, final_live=()):
+    """(peak, op_index) of `sum(var_bytes(name))` over each op's live
+    set (live-in plus own defs).  THE activation-peak walk: the shard
+    analyzer's S005 estimate and the auto_remat pass's accept gate
+    both run it, parameterized only by the byte policy (`var_bytes`:
+    name -> bytes, returning 0 for names that don't count), so the
+    two accountings cannot drift apart structurally."""
+    lv = Liveness(op_descs, final_live=final_live).analyze()
+    peak, peak_op = 0, None
+    for i in range(len(lv.ops)):
+        total = 0
+        for n in lv.live_in[i] | lv.defs[i]:
+            total += var_bytes(n)
+        if total > peak:
+            peak, peak_op = total, i
+    return peak, peak_op
 
 
 class Liveness:
@@ -154,16 +172,35 @@ def _in_place_pairs(od):
     return pairs
 
 
+def _attr_name_refs(od):
+    """Names an op references through plain STRING attrs — the
+    `recurrent` op wires its sub-block through name-list attrs
+    (mem_pre_names/mem_post_names/step_input_names/closure_names/
+    step_output_names), which slot-only scanning cannot see; killing
+    the body ops that define those names silently degenerates the
+    scan.  Conservative by construction: a cosmetic string attr that
+    happens to match a var name only keeps that var alive."""
+    refs = set()
+    for v in od.attrs.values():
+        if isinstance(v, str):
+            refs.add(v)
+        elif isinstance(v, (list, tuple)):
+            refs.update(x for x in v if isinstance(x, str))
+    return refs
+
+
 def _block_name_sets(desc):
     """Per-block sets of every name the block references (op slots +
-    declared vars) — computed ONCE per program; a block's cross-block
-    live set is the union of every OTHER block's set."""
+    string attrs + declared vars) — computed ONCE per program; a
+    block's cross-block live set is the union of every OTHER block's
+    set."""
     sets = []
     for b in desc.blocks:
         names = set(b.vars)
         for od in b.ops:
             names.update(od.input_names())
             names.update(od.output_names())
+            names.update(_attr_name_refs(od))
         names.discard(EMPTY)
         sets.append(names)
     return sets
@@ -201,12 +238,15 @@ def _is_effectful(od):
 def _referenced_names(desc):
     """Every name any op in any block reads or writes — the D002
     universe, computed ONCE per program (analyze_dataflow passes it
-    down)."""
+    down).  String attr refs count: the recurrent op names its
+    carries through attrs, and sweeping those VarDescs would break
+    the scan lowering."""
     referenced = set()
     for b in desc.blocks:
         for od in b.ops:
             referenced.update(od.input_names())
             referenced.update(od.output_names())
+            referenced.update(_attr_name_refs(od))
     return referenced
 
 

@@ -58,7 +58,7 @@ from collections import OrderedDict
 from ..core.types import GRAD_SUFFIX
 from .common import EMPTY, find_var_desc
 from .costmodel import CommCostReport
-from .dataflow import Liveness
+from .dataflow import liveness_peak_bytes
 from .diagnostics import Diagnostic, Report, Severity
 
 __all__ = ["analyze_sharding", "ShardingPlan", "mesh_axis_sizes",
@@ -716,19 +716,16 @@ def _estimate_hbm(desc, bd, plan, axes, fetches, state_param, hbm_gb,
     final_live = {n for n, vd in bd.vars.items() if vd.persistable}
     if fetches:
         final_live |= set(fetches)
-    lv = Liveness(bd.ops, final_live=final_live).analyze()
-    act_peak, peak_op = 0, None
-    for i in range(len(lv.ops)):
-        live = lv.live_in[i] | lv.defs[i]
-        b = 0
-        for n in live:
-            vd = bd.vars.get(n)
-            if vd is None or vd.persistable:
-                continue
-            b += _var_bytes(vd, _spec_for(plan, n, len(vd.shape or ())),
-                            axes)
-        if b > act_peak:
-            act_peak, peak_op = b, i
+
+    def _act_bytes(n):
+        vd = bd.vars.get(n)
+        if vd is None or vd.persistable:
+            return 0
+        return _var_bytes(vd, _spec_for(plan, n, len(vd.shape or ())),
+                          axes)
+
+    act_peak, peak_op = liveness_peak_bytes(bd.ops, _act_bytes,
+                                            final_live)
     total = persist_bytes + state_bytes + act_peak
     plan.peak_hbm_bytes = total
     plan.hbm_breakdown = {

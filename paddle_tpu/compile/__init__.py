@@ -11,11 +11,15 @@ Two halves:
     serving warmup, a supervisor auto-resume — reloads it with ZERO
     new XLA compiles.  Gated by `FLAGS_compile_cache_dir`; off means
     the jit call path is exactly the pre-cache behavior.
-  * `passes` — Program-level IR rewrite passes over the analysis
-    subsystem's def-use/liveness machinery: dead-op/dead-var
-    elimination, shape/fill constant folding, and pure-op CSE, run by
-    a `PassManager` that re-verifies the IR around every pass.  Gated
-    by `FLAGS_compile_passes`.
+  * `passes` + `opt_passes` — Program-level IR rewrite passes over
+    the analysis subsystem's def-use/liveness machinery: the cleanup
+    set (dead-op/dead-var elimination, shape/fill constant folding,
+    pure-op CSE) plus the cost-model-guided optimization passes
+    (`layout` NCHW→NHWC gated on the TPU-tiled roofline, `fuse`
+    elementwise-chain fusion, `auto_remat` budget-driven activation
+    checkpointing — knobs like `fuse:cap=8` fold into the pipeline
+    id), run by a `PassManager` that re-verifies the IR around every
+    pass.  Gated by `FLAGS_compile_passes`.
 
 Operator surface: `python -m paddle_tpu.tools.pcache_cli` ("pcc") for
 stats / prewarm / gc / --selftest.  docs/COMPILE_CACHE.md documents
@@ -25,8 +29,9 @@ the cache-key anatomy, invalidation rules, and the ops runbook.
 from . import fingerprint
 from . import pcache
 from . import passes
+from . import opt_passes
 from .passes import PassManager, optimize_program
 from .pcache import PersistentCache
 
-__all__ = ["fingerprint", "pcache", "passes", "PassManager",
-           "optimize_program", "PersistentCache"]
+__all__ = ["fingerprint", "pcache", "passes", "opt_passes",
+           "PassManager", "optimize_program", "PersistentCache"]

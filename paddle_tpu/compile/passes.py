@@ -20,7 +20,7 @@ The PassManager's `pipeline_id` feeds the executable-cache fingerprint
 (`compile.fingerprint`), so cached entries never alias across pass
 configs.
 
-Passes (registry order is the default pipeline order):
+Cleanup passes (the "default" pipeline, in order):
 
   dce   dead-op elimination — the D001 fixpoint set, removed.  Needs
         the fetch set (fetch is a runtime by-name lookup, invisible to
@@ -40,11 +40,34 @@ Passes (registry order is the default pipeline order):
   dve   dead-var elimination — VarDescs no op in any block references
         (D002), dropped.  Runs last to sweep what dce/cse orphaned.
 
+Cost-model-guided optimization passes (compile/opt_passes.py; opt-in,
+appended to the spec — "default+layout+fuse+auto_remat"):
+
+  layout      NCHW->NHWC rewrite of conv/pool/bn chains, accepted
+              only when the TPU-tiled roofline (fluid/analysis.py)
+              predicts a strictly lower max(MXU, HBM) floor.
+  fuse        greedy fusion of single-use elementwise/activation/bias
+              chains into `fused_elemwise_chain` ops (fluid/fusion.py);
+              `fuse:cap=N` bounds the fused-group size.
+  auto_remat  cost-model-driven activation checkpointing via
+              fluid/recompute.py, applied only when the liveness
+              activation-peak estimate exceeds the HBM budget;
+              `auto_remat:stride=N:budget_gb=G` are the knobs.
+
+Spec grammar: pass tokens separated by ',' or '+' ("default" expands
+to the cleanup pipeline), each token optionally carrying ':'-joined
+`key=value` knobs — `"default+fuse:cap=8+auto_remat:stride=4"`.  The
+knobs fold into `pipeline_id`, so pcache entries never alias across
+knob settings.
+
 Semantics-preservation contract: every pass either removes work whose
 result is never observable (dce/dve), replaces an op by one computing
-the same values from attrs (fold), or reuses an existing bit-identical
-value (cse).  `pcache_cli --selftest` proves pass-optimized and
-unoptimized lenet5 forwards produce bit-identical outputs.
+the same values from attrs (fold), reuses an existing bit-identical
+value (cse), re-expresses the same math in another layout (layout) or
+as one fused kernel applying the identical stage sequence (fuse), or
+recomputes identical forward values in the backward (auto_remat).
+`pcache_cli --selftest` proves pass-optimized and unoptimized lenet5
+forwards produce bit-identical outputs.
 """
 
 import json
@@ -61,7 +84,7 @@ from ..core.desc import OpDesc
 from .fingerprint import _jsonable
 
 __all__ = ["PassManager", "optimize_program", "available_passes",
-           "DEFAULT_PIPELINE"]
+           "register_pass", "DEFAULT_PIPELINE"]
 
 # bump when any pass's rewrite semantics change: the version is part
 # of pipeline_id, so stale cache entries miss instead of aliasing
@@ -70,13 +93,18 @@ _PIPELINE_VERSION = 1
 
 class _PassContext:
     """What a pass may rely on: the runtime fetch names (by-name scope
-    lookups the IR cannot see) and the per-program keep set — names a
+    lookups the IR cannot see), the per-program keep set — names a
     rewrite must never remove or rename away (fetches, persistables,
-    names referenced by other blocks)."""
+    names referenced by other blocks) — and the framework Program
+    wrapper (`program`) for passes built on Program-level machinery
+    (convert_layout, recompute_program).  `note` lets a pass explain
+    WHY it declined to act (surfaced in the PassManager records)."""
 
-    def __init__(self, desc, fetches):
+    def __init__(self, desc, fetches, program=None):
         self.desc = desc
         self.fetches = set(fetches or ())
+        self.program = program
+        self.note = None
 
     def keep_names(self, block_idx):
         bd = self.desc.block(block_idx)
@@ -86,12 +114,72 @@ class _PassContext:
         return keep
 
 
+def _fmt_opt(value):
+    if isinstance(value, float):
+        # repr round-trips exactly (no %g-style 6-digit truncation
+        # that could alias two distinct knob values onto one
+        # pipeline_id); strip the '+' from exponents — '+' is a token
+        # separator in the spec grammar, so '2e+06' would not
+        # re-parse ('2e06' does)
+        return repr(value).replace("e+", "e")
+    return "%s" % value
+
+
 class RewritePass:
     """One Program->Program rewrite.  Subclasses set `name` and
     implement `run(desc, ctx) -> explain-dict-or-None` (None/empty
-    means "changed nothing")."""
+    means "changed nothing").
+
+    Knobbed passes declare `options = {"knob": (coerce, default)}`;
+    the spec grammar `name:knob=value` instantiates a configured copy
+    and the explicit knobs join the pass's `spec_token` (and therefore
+    `pipeline_id` — entries never alias across knob settings)."""
 
     name = None
+    options = {}
+
+    def __init__(self, **opts):
+        unknown = sorted(set(opts) - set(self.options))
+        if unknown:
+            raise ValueError(
+                "pass %r has no option(s) %s; available: %s"
+                % (self.name, unknown, sorted(self.options)))
+        self._explicit = {}
+        for key, (coerce, default) in self.options.items():
+            if key in opts:
+                value = coerce(opts[key])
+                if value != default:
+                    # an explicitly-spelled default ("fuse:cap=0") is
+                    # the SAME pipeline as the bare pass: it must not
+                    # mint a distinct spec_token/pipeline_id (one
+                    # semantics -> one pcache key, one ptune point)
+                    self._explicit[key] = value
+            else:
+                value = default
+            setattr(self, key, value)
+        self.validate_options()
+
+    def validate_options(self):
+        """Subclass hook: raise ValueError for invalid knob values
+        (called at construction, so a bad spec never becomes a
+        pipeline)."""
+
+    @property
+    def spec_token(self):
+        """Canonical spec token: the pass name plus any explicitly-set
+        knobs, sorted — the unit `pipeline_id` is built from."""
+        if not self._explicit:
+            return self.name
+        return self.name + "".join(
+            ":%s=%s" % (k, _fmt_opt(self._explicit[k]))
+            for k in sorted(self._explicit))
+
+    def with_options(self, opts):
+        """A configured instance of this pass's class (the registry
+        holds default-configured singletons)."""
+        if not opts:
+            return self
+        return type(self)(**opts)
 
     def run(self, desc, ctx):
         raise NotImplementedError
@@ -319,11 +407,49 @@ _PASSES = OrderedDict((p.name, p) for p in
                       (DeadOpElimination(), ConstantFold(),
                        CommonSubexpression(), DeadVarElimination()))
 
-DEFAULT_PIPELINE = ",".join(_PASSES)
+# the "default" pipeline is the cleanup set only; the cost-model-guided
+# opt passes (layout/fuse/auto_remat, registered below from
+# opt_passes.py) are opt-in — append them: "default+layout+fuse"
+DEFAULT_PIPELINE = "dce,fold,cse,dve"
+
+
+def register_pass(p):
+    """Add a RewritePass instance to the registry (its class is what
+    `name:knob=value` specs instantiate)."""
+    if not p.name:
+        raise ValueError("pass has no name: %r" % (p,))
+    _PASSES[p.name] = p
+    return p
 
 
 def available_passes():
     return list(_PASSES)
+
+
+def _parse_spec(spec):
+    """spec -> [(name, {opt: raw value})].  Tokens separate on ',' or
+    '+' ("default" expands to the cleanup pipeline); knobs attach with
+    ':' as `name:key=value[:key=value...]`."""
+    tokens = []
+    for part in (spec or "").replace("+", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part == "default":
+            tokens.extend((n, {}) for n in DEFAULT_PIPELINE.split(","))
+            continue
+        fields = part.split(":")
+        name = fields[0].strip()
+        opts = {}
+        for field in fields[1:]:
+            if "=" not in field:
+                raise ValueError(
+                    "malformed pass option %r in token %r (want "
+                    "name:key=value)" % (field, part))
+            key, value = field.split("=", 1)
+            opts[key.strip()] = value.strip()
+        tokens.append((name, opts))
+    return tokens
 
 
 class PassManager:
@@ -342,25 +468,33 @@ class PassManager:
     def __init__(self, spec=DEFAULT_PIPELINE, verify=True,
                  verify_level="structural", explain=False):
         spec = (spec or "").strip()
-        if spec in ("", "default"):
+        if spec == "":
             spec = DEFAULT_PIPELINE
-        names = [s.strip() for s in spec.split(",") if s.strip()]
-        unknown = [n for n in names if n not in _PASSES]
+        parsed = _parse_spec(spec)
+        unknown = [n for n, _ in parsed if n not in _PASSES]
         if unknown:
             raise ValueError("unknown pass(es) %s; available: %s"
                              % (unknown, list(_PASSES)))
-        self.passes = [_PASSES[n] for n in names]
+        self.passes = [_PASSES[n].with_options(opts)
+                       for n, opts in parsed]
         self.verify = bool(verify)
         self.verify_level = verify_level
         self.explain = bool(explain)
         self.records = []
 
     @property
+    def spec(self):
+        """The canonical comma-joined spec these passes resolve to
+        (knobs included) — what tune/space.py normalizes pipelines
+        through."""
+        return ",".join(p.spec_token for p in self.passes)
+
+    @property
     def pipeline_id(self):
         """Stable id of this pass config — part of the executable-
-        cache fingerprint, so entries never alias across configs."""
-        return "v%d:%s" % (_PIPELINE_VERSION,
-                           ",".join(p.name for p in self.passes))
+        cache fingerprint, so entries never alias across configs (knob
+        settings included)."""
+        return "v%d:%s" % (_PIPELINE_VERSION, self.spec)
 
     def _verify(self, desc):
         report = Report()
@@ -378,7 +512,7 @@ class PassManager:
             out = framework.Program.parse_from_string(
                 program.serialize_to_string())
         desc = out.desc
-        ctx = _PassContext(desc, fetches)
+        ctx = _PassContext(desc, fetches, program=out)
         self.records = []
         if self.verify:
             self._verify(desc)
@@ -386,18 +520,20 @@ class PassManager:
             t0 = time.perf_counter()
             ops_before = sum(len(b.ops) for b in desc.blocks)
             vars_before = sum(len(b.vars) for b in desc.blocks)
+            ctx.note = None
             diff = p.run(desc, ctx)
             if self.verify:
                 # a pass that broke the IR fails HERE, named, before
                 # the broken desc can reach segmentation or XLA
                 self._verify(desc)
             self.records.append({
-                "pass": p.name, "changed": bool(diff),
+                "pass": p.spec_token, "changed": bool(diff),
                 "ops_before": ops_before,
                 "ops_after": sum(len(b.ops) for b in desc.blocks),
                 "vars_before": vars_before,
                 "vars_after": sum(len(b.vars) for b in desc.blocks),
                 "seconds": round(time.perf_counter() - t0, 6),
+                "note": ctx.note,
                 "diff": diff if self.explain else None,
             })
         for b in out.blocks:
@@ -408,24 +544,28 @@ class PassManager:
         """Human-readable per-pass diff dump (the `--explain` view)."""
         lines = ["pipeline %s" % self.pipeline_id]
         for r in self.records:
+            idle = "" if r["changed"] else (
+                "  [no change: %s]" % r["note"] if r.get("note")
+                else "  [no change]")
             lines.append(
                 "  %-5s ops %d->%d vars %d->%d (%.1f ms)%s"
                 % (r["pass"], r["ops_before"], r["ops_after"],
                    r["vars_before"], r["vars_after"],
-                   r["seconds"] * 1e3,
-                   "" if r["changed"] else "  [no change]"))
+                   r["seconds"] * 1e3, idle))
             diff = r.get("diff") or {}
             for kind, items in sorted(diff.items()):
                 if isinstance(items, dict):
                     for k, v in sorted(items.items()):
                         lines.append("        %s: %s -> %s"
                                      % (kind, k, v))
-                else:
+                elif isinstance(items, (list, tuple)):
                     for item in items:
                         lines.append("        %s: %s"
                                      % (kind, json.dumps(
                                          item, sort_keys=True,
                                          default=str)))
+                else:  # scalar facts (counts, flags)
+                    lines.append("        %s: %s" % (kind, items))
         return "\n".join(lines)
 
 
@@ -444,3 +584,9 @@ def pipeline_id(spec):
     if not spec:
         return ""
     return PassManager(spec, verify=False).pipeline_id
+
+
+# self-registration of the cost-model-guided optimization passes
+# (layout/fuse/auto_remat) — import LAST so opt_passes can import the
+# RewritePass/register_pass machinery from this module
+from . import opt_passes  # noqa: E402,F401  (registers passes)

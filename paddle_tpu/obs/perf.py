@@ -392,11 +392,13 @@ def classify_split(wall_s, device_s=None, input_s=0.0, host_s=None,
 
 
 def roofline_floors(program, bf16_act=False, peak_tflops=None,
-                    hbm_gbps=None, topk=3):
+                    hbm_gbps=None, topk=3, tpu_tiling=False):
     """The classifier's roofline inputs for one Program, via
     fluid/analysis.py: `t_mxu_s`/`t_hbm_s` (total-FLOPs and
     unique-bytes floors), serial/ideal step floors, and the dominant
-    op types by time floor.  Lazy fluid import (obs stays
+    op types by time floor.  `tpu_tiling=True` switches the byte
+    accounting to physical tile-padded bytes (what the `layout` pass's
+    cost gate compares layouts with).  Lazy fluid import (obs stays
     import-cheap)."""
     from ..fluid import analysis
 
@@ -404,7 +406,8 @@ def roofline_floors(program, bf16_act=False, peak_tflops=None,
                            else analysis.DEFAULT_PEAK_TFLOPS / 2)
     bw = hbm_gbps or analysis.DEFAULT_HBM_GBPS
     rep = analysis.roofline_report(program, peak_tflops=peak,
-                                   hbm_gbps=bw, bf16_act=bf16_act)
+                                   hbm_gbps=bw, bf16_act=bf16_act,
+                                   tpu_tiling=tpu_tiling)
     per = sorted(rep["per_type"].items(), key=lambda kv: -kv[1]["t_ms"])
     return {
         "t_mxu_s": rep["total_gflops"] / (peak * 1e3),

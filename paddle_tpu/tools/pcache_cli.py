@@ -335,12 +335,73 @@ def _selftest_passes(args, report):
                                      report["passes"]), flush=True)
 
 
+def _selftest_opt_passes(workdir, report):
+    """5. the cost-model-guided opt pipeline x the persistent cache:
+    a layout+fuse pipeline must produce a DIFFERENT cache key than
+    `default` (knob settings included — entries never alias), and the
+    optimized program must reload from disk with 0 fresh XLA compiles
+    while staying bit-identical to the unoptimized forward."""
+    import numpy as np
+
+    from paddle_tpu.compile import pcache
+    from paddle_tpu.compile import passes as passes_mod
+    from paddle_tpu.obs import telemetry as obs_tele
+    from paddle_tpu.utils import flags
+
+    spec = "default+layout:force=1+fuse"
+    ids = {passes_mod.pipeline_id("default"),
+           passes_mod.pipeline_id(spec),
+           passes_mod.pipeline_id(spec + ":cap=2")}
+    assert len(ids) == 3, \
+        "pipeline ids alias across pass/knob configs: %s" % ids
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(4, 1, 28, 28).astype(np.float32)
+    cache_dir = os.path.join(workdir, "optcache")
+    try:
+        # the unoptimized reference output first (no cache, no passes)
+        _fresh_workspace()
+        main, startup, probs = _build_lenet5_forward()
+        out_plain = _run_forward(main, startup, probs, img)
+
+        flags.set_flag("compile_cache_dir", cache_dir)
+        flags.set_flag("compile_passes", spec)
+        pcache.reset()
+        _fresh_workspace()
+        traces0 = obs_tele.jit_trace_count()
+        main, startup, probs = _build_lenet5_forward()
+        out_cold = _run_forward(main, startup, probs, img)
+        cold = obs_tele.jit_trace_count() - traces0
+        assert cold > 0, "optimized cold run compiled nothing"
+        np.testing.assert_array_equal(out_plain, out_cold)
+
+        _fresh_workspace()
+        pcache.reset()
+        traces1 = obs_tele.jit_trace_count()
+        main, startup, probs = _build_lenet5_forward()
+        out_warm = _run_forward(main, startup, probs, img)
+        warm = obs_tele.jit_trace_count() - traces1
+        assert warm == 0, \
+            "optimized warm reload performed %d XLA compile(s)" % warm
+        np.testing.assert_array_equal(out_cold, out_warm)
+    finally:
+        flags.set_flag("compile_cache_dir", "")
+        flags.set_flag("compile_passes", "")
+        pcache.reset()
+    report["opt_pipeline"] = passes_mod.pipeline_id(spec)
+    print("[pcc] opt-passes leg green: %s keys apart from default "
+          "(and per knob), optimized program bit-identical and "
+          "reloaded from disk with 0 fresh compiles"
+          % passes_mod.pipeline_id(spec), flush=True)
+
+
 def selftest(args):
     workdir = tempfile.mkdtemp(prefix="paddle_pcc_")
     report = {}
     try:
         _selftest_cache(workdir, report)
         _selftest_passes(args, report)
+        _selftest_opt_passes(workdir, report)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     print("[pcc] selftest green: cold %ss -> warm %ss (%d segments), "

@@ -86,7 +86,16 @@ def parse_args(argv=None):
     p.add_argument("--pipelines", default="none,default",
                    help="pass pipelines (comma list of 'none', "
                         "'default', or +-joined pass names like "
-                        "dce+fold)")
+                        "dce+fold or default+layout+fuse+auto_remat; "
+                        "pass knobs attach with ':' — fuse:cap=8)")
+    p.add_argument("--fusion-caps", default="0",
+                   help="fuse:cap= settings crossed with pipelines "
+                        "containing a bare fuse pass (comma ints; 0 = "
+                        "pipeline default)")
+    p.add_argument("--remat-strides", default="0",
+                   help="auto_remat:stride= settings crossed with "
+                        "pipelines containing a bare auto_remat pass "
+                        "(comma ints; 0 = pipeline default)")
     # plan: the cost model
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--class-dim", type=int, default=None)
@@ -137,7 +146,9 @@ def _build_space(args):
         pipelines=_pipelines(args.pipelines),
         batches=_csv_int(args.batches),
         micro_batches=_csv_int(args.micro_batches),
-        axes=tuple(_csv(args.axes)))
+        axes=tuple(_csv(args.axes)),
+        fusion_caps=_csv_int(args.fusion_caps),
+        remat_strides=_csv_int(args.remat_strides))
 
 
 def _rank_plan(args, extra_candidates=(), hbm_gb="arg"):

@@ -395,7 +395,14 @@ def rank(builder, candidates, chips, model=None, hbm_gb=None,
                                       d.format()))
             continue
 
-        # per-device peak HBM with the micro-batch activation scaling
+        # per-device peak HBM with the micro-batch activation scaling.
+        # NOTE: the analyzer ran over the PASS-OPTIMIZED program
+        # (`_optimized` applies the candidate's pipeline before
+        # `_analysis`), so an `auto_remat` candidate is priced with
+        # its REDUCED liveness activation peak — remat widens the
+        # S005 budget exactly as it will at runtime, and the extra
+        # recompute FLOPs land in the compute term through `_floors`
+        # over the same optimized program.
         bd = plan.hbm_breakdown
         m = cand.micro_batches
         act = int(bd.get("activation_peak_bytes", 0))

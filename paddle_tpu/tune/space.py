@@ -21,13 +21,21 @@ Knobs:
                  mesh is a ValueError, never a candidate.
   pipeline       a `compile.passes.PassManager` spec ("none" for the
                  raw program, "default" for dce,fold,cse,dve, or any
-                 comma list of registered passes).  Unknown pass names
-                 are rejected at construction.
+                 comma list of registered passes — the opt passes
+                 layout/fuse/auto_remat included, knobs and all:
+                 "default+fuse:cap=8").  Unknown pass names are
+                 rejected at construction.
   batch          global batch size (split over the dp axis).
   micro_batches  μ-cuDNN-style split of the per-device batch into m
                  sequential micro-steps — the memory-vs-speed knob
                  (PAPERS.md): activations scale ~1/m, dispatch
                  overhead scales ~m.
+  fusion_caps    `fuse:cap=` settings crossed with the pipelines that
+                 contain a bare `fuse` pass (0 = leave the pipeline's
+                 own setting); a cap paired with a fuse-less pipeline
+                 is skipped AT ENUMERATION — no invalid points.
+  remat_strides  `auto_remat:stride=` settings, same contract against
+                 pipelines containing a bare `auto_remat` pass.
 
 Deeper validity (S001–S005) is the sharding analyzer's job; `rank.py`
 runs it per candidate and rejects what the space could not see
@@ -39,13 +47,20 @@ from collections import OrderedDict
 
 __all__ = ["Candidate", "SearchSpace", "mesh_shapes_for",
            "default_constraints", "DEFAULT_PIPELINES",
-           "DEFAULT_BATCHES", "DEFAULT_MICRO_BATCHES"]
+           "DEFAULT_BATCHES", "DEFAULT_MICRO_BATCHES",
+           "DEFAULT_FUSION_CAPS", "DEFAULT_REMAT_STRIDES"]
 
 # "none" keeps the program as built; "default" is the full verified
 # rewrite pipeline (compile/passes.py DEFAULT_PIPELINE)
 DEFAULT_PIPELINES = ("none", "default")
 DEFAULT_BATCHES = (64, 128, 256)
 DEFAULT_MICRO_BATCHES = (1, 2, 4)
+# 0 = "leave the pipeline's own knob": the default space does not
+# multiply itself by pass knobs until the pipelines list opts into
+# the opt passes (e.g. --pipelines default+fuse+auto_remat
+# --fusion-caps 0,4,8 --remat-strides 0,4,8)
+DEFAULT_FUSION_CAPS = (0,)
+DEFAULT_REMAT_STRIDES = (0,)
 
 
 def _divisors(n):
@@ -78,17 +93,64 @@ def mesh_shapes_for(chips, axes=("dp", "mp")):
 
 def _normalize_pipeline(spec):
     """CLI pipeline names -> PassManager specs ("" = no passes);
-    validates pass names at SPACE construction so a typo'd pipeline
-    can never become a candidate."""
+    validates pass names AND pass knobs at SPACE construction so a
+    typo'd pipeline can never become a candidate."""
     spec = (spec or "").strip()
     if spec in ("none", "raw", ""):
         return ""
     from ..compile.passes import PassManager
 
-    # construction validates the names; "default" expands here so two
-    # spellings of one pipeline cannot enumerate as two points
-    return ",".join(p.name for p in
-                    PassManager(spec, verify=False).passes)
+    # construction validates names and knob values; "default" expands
+    # here (and knobs canonicalize) so two spellings of one pipeline
+    # cannot enumerate as two points
+    return PassManager(spec, verify=False).spec
+
+
+def _fold_knob(tokens, pass_name, knob_token, knob_desc):
+    """Replace the single bare `pass_name` token in `tokens` (a list,
+    mutated in place) with `knob_token`.  Returns None on success or a
+    skip reason: pass absent, pass already knobbed, or pass repeated
+    (folding into one of several occurrences would be ambiguous AND
+    the old name-keyed dict silently dropped the duplicates — the
+    knobbed variant must never run a different pipeline than the
+    baseline it is compared against)."""
+    bare = [i for i, t in enumerate(tokens) if t == pass_name]
+    pinned = [t for t in tokens
+              if t.startswith(pass_name + ":")]
+    if not bare:
+        if pinned:
+            return "pipeline already pins %s knobs (%s)" \
+                % (pass_name, pinned[0])
+        return "%s needs the %s pass in the pipeline" \
+            % (knob_desc, pass_name)
+    if len(bare) + len(pinned) > 1:
+        return "pipeline repeats the %s pass; knob folding would be " \
+            "ambiguous" % pass_name
+    tokens[bare[0]] = knob_token
+    return None
+
+
+def _apply_pass_knobs(pipeline, fusion_cap, remat_stride):
+    """Fold the space's fusion_cap/remat_stride dimensions into one
+    pipeline spec.  Returns (spec, None) for a valid combination or
+    (None, reason) for one that must be SKIPPED at enumeration —
+    a knob aimed at a pass the pipeline does not run, or at a pass
+    that already pins that knob, is never a candidate."""
+    if not fusion_cap and not remat_stride:
+        return pipeline, None
+    tokens = [t for t in pipeline.split(",") if t]
+    if fusion_cap:
+        why = _fold_knob(tokens, "fuse", "fuse:cap=%d" % fusion_cap,
+                         "fusion_cap=%d" % fusion_cap)
+        if why:
+            return None, why
+    if remat_stride:
+        why = _fold_knob(tokens, "auto_remat",
+                         "auto_remat:stride=%d" % remat_stride,
+                         "remat_stride=%d" % remat_stride)
+        if why:
+            return None, why
+    return ",".join(tokens), None
 
 
 class Candidate:
@@ -252,19 +314,27 @@ class SearchSpace:
         correct by construction).
     meshes: explicit mesh-spec list, or None to enumerate every
         factorization over `axes`.
+    fusion_caps / remat_strides: `fuse:cap=` / `auto_remat:stride=`
+        settings crossed with the pipelines (0 = leave the pipeline's
+        own knob); combinations aimed at a pass the pipeline does not
+        run are skipped at enumeration with a reason — no invalid
+        points.
     constraints: extra per-knob predicates appended to
         `default_constraints()` (each: Candidate -> None | reason).
 
     `points()` is deterministic: mesh (leading axis descending) ->
-    batch -> micro_batches -> pipeline, constraints applied at
-    enumeration so invalid points never exist.  `skipped` records
-    what the constraints rejected (tag -> reason) for the plan log.
+    batch -> micro_batches -> pipeline -> fusion_cap -> remat_stride,
+    constraints applied at enumeration so invalid points never exist.
+    `skipped` records what the constraints rejected (tag -> reason)
+    for the plan log.
     """
 
     def __init__(self, chips, meshes=None, pipelines=DEFAULT_PIPELINES,
                  batches=DEFAULT_BATCHES,
                  micro_batches=DEFAULT_MICRO_BATCHES,
-                 axes=("dp", "mp"), constraints=None):
+                 axes=("dp", "mp"), constraints=None,
+                 fusion_caps=DEFAULT_FUSION_CAPS,
+                 remat_strides=DEFAULT_REMAT_STRIDES):
         from ..parallel.mesh import parse_mesh_spec
 
         self.chips = int(chips)
@@ -295,28 +365,62 @@ class SearchSpace:
         if any(m < 1 for m in self.micro_batches):
             raise ValueError("micro_batches must be >= 1: %r"
                              % (micro_batches,))
+        self.fusion_caps = [int(c) for c in fusion_caps]
+        self.remat_strides = [int(s) for s in remat_strides]
+        if any(c < 0 or c == 1 for c in self.fusion_caps):
+            raise ValueError("fusion_caps must be 0 (pipeline default) "
+                             "or >= 2: %r" % (fusion_caps,))
+        if any(s < 0 for s in self.remat_strides):
+            raise ValueError("remat_strides must be >= 0: %r"
+                             % (remat_strides,))
         self.constraints = default_constraints() + \
             list(constraints or [])
         self.skipped = OrderedDict()
 
     def points(self):
-        """Enumerate the valid candidates (deterministic order)."""
+        """Enumerate the valid candidates (deterministic order).
+        Duplicate points are skipped with a reason: a knob spelled at
+        its pass default ("auto_remat:stride=8" when 8 IS the
+        default) normalizes to the bare pass, so two knob settings
+        can denote ONE pipeline — it must rank and measure once."""
         self.skipped = OrderedDict()
+        seen = set()
         out = []
         for mesh in self.meshes:
             for batch in self.batches:
                 for micro in self.micro_batches:
                     for pipe in self.pipelines:
-                        cand = Candidate(mesh, pipe, batch, micro)
-                        reason = None
-                        for check in self.constraints:
-                            reason = check(cand)
-                            if reason:
-                                break
-                        if reason:
-                            self.skipped[cand.tag()] = reason
-                            continue
-                        out.append(cand)
+                        for cap in self.fusion_caps:
+                            for stride in self.remat_strides:
+                                spec, why = _apply_pass_knobs(
+                                    pipe, cap, stride)
+                                if spec is None:
+                                    key = "%s-b%d-mb%d-%s+cap%d+rs%d" \
+                                        % (mesh.replace("=", "")
+                                           .replace(",", "."),
+                                           batch, micro, pipe or "none",
+                                           cap, stride)
+                                    self.skipped[key] = why
+                                    continue
+                                cand = Candidate(mesh, spec, batch,
+                                                 micro)
+                                reason = None
+                                for check in self.constraints:
+                                    reason = check(cand)
+                                    if reason:
+                                        break
+                                if reason:
+                                    self.skipped[cand.tag()] = reason
+                                    continue
+                                if cand in seen:
+                                    self.skipped[
+                                        "%s+cap%d+rs%d"
+                                        % (cand.tag(), cap, stride)] = \
+                                        "duplicate point after knob " \
+                                        "normalization"
+                                    continue
+                                seen.add(cand)
+                                out.append(cand)
         return out
 
     def to_dict(self):
@@ -326,4 +430,6 @@ class SearchSpace:
             "pipelines": [p or "none" for p in self.pipelines],
             "batches": list(self.batches),
             "micro_batches": list(self.micro_batches),
+            "fusion_caps": list(self.fusion_caps),
+            "remat_strides": list(self.remat_strides),
         }

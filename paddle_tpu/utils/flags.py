@@ -140,10 +140,14 @@ DEFINE_flag("compile_cache_max_bytes", 2 << 30,
 DEFINE_flag("compile_passes", "",
             "Program-level IR rewrite pipeline applied by the "
             "executor before compiling a program "
-            "(paddle_tpu.compile.passes): a comma list of pass names "
-            "(dce,fold,cse,dve) or 'default' for the standard "
-            "pipeline.  Every pass is re-verified with the analysis "
-            "verifier before and after it runs, and the pipeline id "
+            "(paddle_tpu.compile.passes): pass names joined by ',' "
+            "or '+' — the cleanup set (dce,fold,cse,dve; 'default') "
+            "plus the cost-model-guided opt passes "
+            "(layout/fuse/auto_remat, compile/opt_passes.py), with "
+            "knobs attached via ':' as in "
+            "'default+fuse:cap=8+auto_remat:stride=4'.  Every pass "
+            "is re-verified with the analysis verifier before and "
+            "after it runs, and the pipeline id (knobs included) "
             "feeds the executable-cache fingerprint so cached "
             "entries never alias across pass configs.  Empty (the "
             "default) compiles programs exactly as built")

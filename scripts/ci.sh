@@ -34,7 +34,7 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
-echo "[ci] pcc selftest (cold compile populates cache, restart reload = 0 XLA compiles, corrupt entry quarantined, rewrite passes bit-identical) ..."
+echo "[ci] pcc selftest (cold compile populates cache, restart reload = 0 XLA compiles, corrupt entry quarantined, rewrite passes bit-identical, layout+fuse pipeline keys distinct + warm reloads) ..."
 timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 
 echo "[ci] pperf selftest (gate discriminates 20% regression + tpu-stale, step profiler ring/exports, loopback SLO burn, warm pcache blob) ..."
@@ -48,6 +48,10 @@ timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
 echo "[ci] proglint golden fixtures (checked-in IR must be well-formed, not just pinned) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --golden --quiet
+
+echo "[ci] proglint --golden over POST-PASS programs (a rewrite pass can never emit a program the linter would reject; auto_remat forced via budget_gb=0) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --golden --quiet \
+    --passes "default+layout:force=1+fuse+auto_remat:stride=4:budget_gb=0"
 
 echo "[ci] proglint --mesh over the four dryrun mesh shapes (pinned IR must also SHARD clean) ..."
 for mesh in dp=4,mp=2 dp=2,mp=2,sp=2 pp=4,dp=2 dp=2,ep=4; do

@@ -80,6 +80,14 @@ CONFIGS = [
     ("b256rcp8", {"BENCH_BATCH": "256", "BENCH_RECOMPUTE": "8"}),
     ("nhwc-b128", {"BENCH_LAYOUT": "NHWC"}),
     ("f32-b128", {"BENCH_AMP": "0"}),
+    # --- cost-model-guided pass pipeline (compile/opt_passes.py):
+    # auto_remat prices the HBM-bound b256 leg's activation peak
+    # against the budget and rematerializes only when it busts ---
+    ("opt-b256", {"BENCH_BATCH": "256",
+                  "FLAGS_compile_passes": "default+auto_remat:stride=8"}),
+    # --- device-prefetch input pipeline vs the input-bound verdict
+    # (AlexNet 14% MFU): the A/B that measures the overlap win ---
+    ("alexnet-pf2", {"BENCH_MODEL": "alexnet", "BENCH_PREFETCH": "2"}),
     # --- the model suite (BASELINE.md rows) ---
     ("vgg16", {"BENCH_MODEL": "vgg16"}),
     ("alexnet", {"BENCH_MODEL": "alexnet"}),
@@ -89,6 +97,12 @@ CONFIGS = [
     # --- inference rows (IntelOptimizedPaddle.md:68-104) ---
     ("infer-resnet50", {"BENCH_MODEL": "resnet50",
                         "BENCH_MODE": "infer"}),
+    # the layout+fuse pipeline applies to the inference clone (no
+    # backward): NHWC accepted only on a predicted tiled-roofline win
+    ("infer-resnet50-opt", {"BENCH_MODEL": "resnet50",
+                            "BENCH_MODE": "infer",
+                            "FLAGS_compile_passes":
+                                "default+layout+fuse"}),
     ("infer-vgg19", {"BENCH_MODEL": "vgg19", "BENCH_MODE": "infer"}),
     ("infer-googlenet", {"BENCH_MODEL": "googlenet",
                          "BENCH_MODE": "infer"}),
@@ -103,9 +117,9 @@ CONFIGS = [
 _MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",
             "BENCH_HIDDEN", "BENCH_RECOMPUTE", "BENCH_LAYOUT",
             "BENCH_AMP", "BENCH_LEG", "BENCH_MESH",
-            "BENCH_MICRO_BATCH", "FLAGS_amp_bf16_act",
-            "FLAGS_fuse_optimizer", "FLAGS_bn_shifted_stats",
-            "FLAGS_compile_passes")
+            "BENCH_MICRO_BATCH", "BENCH_PREFETCH",
+            "FLAGS_amp_bf16_act", "FLAGS_fuse_optimizer",
+            "FLAGS_bn_shifted_stats", "FLAGS_compile_passes")
 
 # legs whose single huge graph has wedged the remote compile service
 # (sweep 1: googlenet >40 min, killed): run these behind the

@@ -158,6 +158,30 @@ class TestControlFlow:
         assert float(plain[0]) == 30.0
 
 
+    def test_dce_preserves_recurrent_body(self):
+        """Regression: the `recurrent` op wires its sub-block through
+        NAME-LIST ATTRS (mem_post_names/step_output_names...), not
+        slots — the dead-op fixpoint must count string attr refs as
+        live or the whole scan body is removed when fetches are
+        given."""
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                              lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            step = drnn.step_input(x)
+            mem = drnn.memory(shape=[8], batch_ref=step, value=0.0)
+            h = fluid.layers.fc(input=[step, mem], size=8, act="tanh")
+            drnn.update_memory(mem, h)
+            drnn.output(h)
+        last = fluid.layers.sequence_last_step(input=drnn())
+        loss = fluid.layers.mean(x=last)
+        main = fluid.default_main_program()
+        opt = passes.PassManager("dce,dve").run(main,
+                                                fetches=[loss.name])
+        assert len(opt.desc.block(1).ops) == \
+            len(main.desc.block(1).ops)
+
+
 class TestPassManager:
     def test_semantics_preserved_bit_identical(self):
         main, startup, fetch = _crafted()

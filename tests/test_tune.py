@@ -76,6 +76,91 @@ def test_candidate_identity_and_bench_env():
         Candidate("dp=4,mp=2", "", 64, 2)
 
 
+def test_space_pass_knob_dimensions():
+    """fusion_caps/remat_strides cross only with pipelines carrying a
+    bare fuse/auto_remat pass; invalid combos are skipped AT
+    enumeration (never candidates), and the knob folds into the
+    candidate's pipeline spec + pipeline id."""
+    space = SearchSpace(
+        4, meshes=["dp=4"], batches=[64], micro_batches=[1],
+        pipelines=["none", "default+fuse+auto_remat"],
+        fusion_caps=[0, 4], remat_strides=[0, 4])
+    points = space.points()
+    specs = [c.pipeline for c in points]
+    assert "dce,fold,cse,dve,fuse:cap=4,auto_remat:stride=4" in specs
+    assert "dce,fold,cse,dve,fuse,auto_remat" in specs
+    # "none" never grows knobs; the knobbed combos with it are skipped
+    assert "" in specs
+    assert any("needs the fuse pass" in r for r in space.skipped.values())
+    assert any("needs the auto_remat pass" in r
+               for r in space.skipped.values())
+    ids = {c.pipeline_id() for c in points}
+    assert len(ids) == len(points)  # knob settings never alias
+    # deterministic enumeration with knobs
+    again = SearchSpace(
+        4, meshes=["dp=4"], batches=[64], micro_batches=[1],
+        pipelines=["none", "default+fuse+auto_remat"],
+        fusion_caps=[0, 4], remat_strides=[0, 4]).points()
+    assert [c.tag() for c in points] == [c.tag() for c in again]
+
+
+def test_space_dedupes_default_valued_knob():
+    """A knob spelled at its pass default ("auto_remat:stride=8" — 8
+    IS the default) normalizes to the bare pass: the space must rank
+    that pipeline ONCE, skipping the duplicate with a reason."""
+    space = SearchSpace(
+        4, meshes=["dp=4"], batches=[64], micro_batches=[1],
+        pipelines=["default+auto_remat"], remat_strides=[0, 8])
+    points = space.points()
+    assert [c.pipeline for c in points] == \
+        ["dce,fold,cse,dve,auto_remat"]
+    assert any("duplicate point" in r for r in space.skipped.values())
+
+
+def test_space_rejects_invalid_pass_knobs_at_construction():
+    with pytest.raises(ValueError, match="fusion_caps"):
+        SearchSpace(4, fusion_caps=[1])
+    with pytest.raises(ValueError, match="remat_strides"):
+        SearchSpace(4, remat_strides=[-1])
+    # a knobbed pipeline spec with a bad knob value dies at
+    # construction too (PassManager validates)
+    with pytest.raises(ValueError, match="cap"):
+        SearchSpace(4, pipelines=["default+fuse:cap=1"])
+
+
+def test_space_skips_double_pinned_knob():
+    space = SearchSpace(
+        4, meshes=["dp=4"], batches=[64], micro_batches=[1],
+        pipelines=["fuse:cap=2"], fusion_caps=[0, 4])
+    points = space.points()
+    assert [c.pipeline for c in points] == ["fuse:cap=2"]
+    assert any("already pins" in r for r in space.skipped.values())
+
+
+def test_space_knob_fold_preserves_repeated_other_passes():
+    """Regression: the fold must rewrite the token LIST, not a
+    name-keyed dict — a pipeline repeating some OTHER pass (dce twice)
+    must keep both occurrences in the knobbed variant, or the knob A/B
+    silently compares two different pipelines."""
+    space = SearchSpace(
+        4, meshes=["dp=4"], batches=[64], micro_batches=[1],
+        pipelines=["dce,fuse,dce"], fusion_caps=[0, 4])
+    specs = [c.pipeline for c in space.points()]
+    assert specs == ["dce,fuse,dce", "dce,fuse:cap=4,dce"]
+
+
+def test_space_skips_repeated_target_pass_knob_fold():
+    """Folding a knob into a pipeline that repeats the TARGET pass is
+    ambiguous: skipped with a reason, never a candidate."""
+    space = SearchSpace(
+        4, meshes=["dp=4"], batches=[64], micro_batches=[1],
+        pipelines=["fuse,dce,fuse"], fusion_caps=[0, 4])
+    specs = [c.pipeline for c in space.points()]
+    assert specs == ["fuse,dce,fuse"]
+    assert any("repeats the fuse pass" in r
+               for r in space.skipped.values())
+
+
 # ---------------------------------------------------------------------------
 # static ranking
 # ---------------------------------------------------------------------------
@@ -136,6 +221,28 @@ def test_rank_micro_batch_scales_activation_hbm():
     # ...at the price of overhead, not compute
     assert by_mb[2].terms["overhead_s"] > by_mb[1].terms["overhead_s"]
     assert by_mb[2].terms["compute_s"] == by_mb[1].terms["compute_s"]
+
+
+def test_rank_prices_auto_remat_with_reduced_activation_peak():
+    """An auto_remat candidate is analyzed over its PASS-OPTIMIZED
+    program, so its S005 pricing uses the post-remat (reduced)
+    liveness activation peak — and pays for it in the compute term
+    (the recompute FLOPs/bytes are real)."""
+    space = SearchSpace(
+        8, meshes=["dp=8,mp=1"], batches=[32], micro_batches=[1],
+        pipelines=["none", "default+auto_remat:stride=2:budget_gb=0"])
+    plan = tune_rank.rank(
+        tune_models.builder("lenet5"), space.points(), 8,
+        model="lenet5", hbm_gb=16, space_dict=space.to_dict(),
+        skipped=space.skipped)
+    assert len(plan.ranked) == 2 and not plan.rejected
+    by_pipe = {e.candidate.pipeline_label: e for e in plan.ranked}
+    remat = by_pipe["dce,fold,cse,dve,auto_remat:budget_gb=0.0:stride=2"]
+    raw = by_pipe["none"]
+    assert remat.hbm_breakdown["activation_peak_bytes"] \
+        < raw.hbm_breakdown["activation_peak_bytes"]
+    assert remat.peak_hbm_bytes < raw.peak_hbm_bytes
+    assert remat.terms["compute_s"] > raw.terms["compute_s"]
 
 
 def test_rank_mesh_product_must_match_chips():
