@@ -651,6 +651,23 @@ def main():
     except Exception as exc:  # noqa: BLE001 — a blob failure must
         print("bench: perf blob failed: %r" % (exc,),   # not eat the
               file=sys.stderr, flush=True)              # measurement
+    # the memory blob: static liveness peak vs the AOT artifact's XLA
+    # memory_analysis footprint + the device watermark (obs/mem.py) —
+    # every record carries its HBM story so `pperf gate
+    # --mem-tolerance` can fail an HBM regression like a step-time
+    # one.  BENCH_MEMORY=0 opts out (mega_bench sets it for RISKY
+    # legs).
+    mem_blob = None
+    if os.environ.get("BENCH_MEMORY", "1") != "0":
+        try:
+            from paddle_tpu.obs import mem as obs_mem
+
+            mem_blob = obs_mem.bench_memory_blob(
+                main_prog, fetches=[avg_loss.name],
+                xla_stats=xla_stats)
+        except Exception as exc:  # noqa: BLE001 — same contract as
+            print("bench: memory blob failed: %r" % (exc,),  # perf
+                  file=sys.stderr, flush=True)
     metric = _tagged(metric, rcp, micro, prefetch)
     record = {
         "metric": metric,
@@ -668,6 +685,7 @@ def main():
         # the platform JAX actually ran on, not the requested one
         "platform": dev.platform + ("-fallback" if fallback else ""),
         "perf": perf_blob,
+        "memory": mem_blob,
         # the candidate point this record measured (tune/fit.py joins
         # history rows back to their plan entry through this)
         "config": _config_blob(

@@ -42,25 +42,67 @@ from .common import EMPTY, resolve_op_info
 from .diagnostics import Diagnostic, Report, Severity
 
 __all__ = ["Liveness", "analyze_block", "analyze_dataflow",
-           "dead_op_indices", "liveness_peak_bytes"]
+           "dead_op_indices", "liveness_peak_bytes",
+           "liveness_timeline"]
+
+
+def liveness_timeline(op_descs, var_bytes, final_live=(), top_n=0):
+    """Per-op live-bytes series of `sum(var_bytes(name))` over each
+    op's live set (live-in plus own defs).  THE activation-peak walk:
+    the shard analyzer's S005 estimate, the auto_remat pass's accept
+    gate, and the obs.mem memory timeline all run it, parameterized
+    only by the byte policy (`var_bytes`: name -> bytes, returning 0
+    for names that don't count), so the accountings cannot drift
+    apart structurally.
+
+    Returns {"series": [bytes per op], "peak_bytes", "peak_op",
+    "top_buffers"}; `top_buffers` (only when top_n > 0) lists the
+    top-N nonzero buffers live at the peak, largest first, each
+    blamed to its defining op — `{"name", "bytes", "def_op",
+    "def_op_type"}` (def_op None for values live from outside the op
+    list: feeds, carried state)."""
+    lv = Liveness(op_descs, final_live=final_live).analyze()
+    cache = {}
+
+    def nbytes(name):
+        b = cache.get(name)
+        if b is None:
+            b = cache[name] = var_bytes(name)
+        return b
+
+    series = []
+    peak, peak_op, peak_live = 0, None, ()
+    for i in range(len(lv.ops)):
+        live = lv.live_in[i] | lv.defs[i]
+        total = 0
+        for n in live:
+            total += nbytes(n)
+        series.append(total)
+        if total > peak:
+            peak, peak_op, peak_live = total, i, live
+    top = []
+    if top_n and peak_live:
+        def_sites = lv.def_sites()
+        ranked = sorted(peak_live, key=lambda n: (-nbytes(n), n))
+        for name in ranked[:int(top_n)]:
+            if nbytes(name) <= 0:
+                break
+            defs = [d for d in def_sites.get(name, ())
+                    if d <= peak_op]
+            d = defs[-1] if defs else None
+            top.append({"name": name, "bytes": int(nbytes(name)),
+                        "def_op": d,
+                        "def_op_type": (lv.ops[d].type
+                                        if d is not None else None)})
+    return {"series": series, "peak_bytes": peak, "peak_op": peak_op,
+            "top_buffers": top}
 
 
 def liveness_peak_bytes(op_descs, var_bytes, final_live=()):
-    """(peak, op_index) of `sum(var_bytes(name))` over each op's live
-    set (live-in plus own defs).  THE activation-peak walk: the shard
-    analyzer's S005 estimate and the auto_remat pass's accept gate
-    both run it, parameterized only by the byte policy (`var_bytes`:
-    name -> bytes, returning 0 for names that don't count), so the
-    two accountings cannot drift apart structurally."""
-    lv = Liveness(op_descs, final_live=final_live).analyze()
-    peak, peak_op = 0, None
-    for i in range(len(lv.ops)):
-        total = 0
-        for n in lv.live_in[i] | lv.defs[i]:
-            total += var_bytes(n)
-        if total > peak:
-            peak, peak_op = total, i
-    return peak, peak_op
+    """(peak, op_index) — the timeline walk reduced to its peak; see
+    `liveness_timeline` for the full series + blamed buffers."""
+    tl = liveness_timeline(op_descs, var_bytes, final_live=final_live)
+    return tl["peak_bytes"], tl["peak_op"]
 
 
 class Liveness:

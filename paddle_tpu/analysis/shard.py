@@ -58,7 +58,7 @@ from collections import OrderedDict
 from ..core.types import GRAD_SUFFIX
 from .common import EMPTY, find_var_desc
 from .costmodel import CommCostReport
-from .dataflow import liveness_peak_bytes
+from .dataflow import liveness_timeline
 from .diagnostics import Diagnostic, Report, Severity
 
 __all__ = ["analyze_sharding", "ShardingPlan", "mesh_axis_sizes",
@@ -724,8 +724,8 @@ def _estimate_hbm(desc, bd, plan, axes, fetches, state_param, hbm_gb,
         return _var_bytes(vd, _spec_for(plan, n, len(vd.shape or ())),
                           axes)
 
-    act_peak, peak_op = liveness_peak_bytes(bd.ops, _act_bytes,
-                                            final_live)
+    tl = liveness_timeline(bd.ops, _act_bytes, final_live, top_n=3)
+    act_peak, peak_op = tl["peak_bytes"], tl["peak_op"]
     total = persist_bytes + state_bytes + act_peak
     plan.peak_hbm_bytes = total
     plan.hbm_breakdown = {
@@ -733,16 +733,27 @@ def _estimate_hbm(desc, bd, plan, axes, fetches, state_param, hbm_gb,
         "optimizer_state_bytes": state_bytes,
         "activation_peak_bytes": act_peak,
         "activation_peak_op": peak_op,
+        # the top resident activations at the peak, blamed to their
+        # defining ops (one shared liveness_timeline walk — the same
+        # accounting the S005 total uses): the error can name WHICH
+        # activations to remat instead of citing only totals
+        "top_buffers": tl["top_buffers"],
     }
     if hbm_gb is not None and total > float(hbm_gb) * (1 << 30):
+        top = "; ".join(
+            "%s %.1f MiB (op %s %s)"
+            % (b["name"], b["bytes"] / 2**20, b["def_op"],
+               b["def_op_type"])
+            for b in tl["top_buffers"])
         report.add(Diagnostic(
             "S005", Severity.ERROR,
             "static per-device peak HBM %.3f GiB (params %.3f + "
             "optimizer state %.3f + activation peak %.3f at op %s) "
-            "exceeds the %.3f GiB budget"
+            "exceeds the %.3f GiB budget%s"
             % (total / 2**30, persist_bytes / 2**30,
                state_bytes / 2**30, act_peak / 2**30, peak_op,
-               float(hbm_gb)),
+               float(hbm_gb),
+               "" if not top else " — top resident: " + top),
             op_index=peak_op))
 
 

@@ -42,6 +42,7 @@ from ..core.ragged import RaggedTensor, SelectedRows
 from ..core.types import np_dtype, VarType
 from ..obs import flight as obs_flight
 from ..obs import health as obs_health
+from ..obs import mem as obs_mem
 from ..obs import telemetry as obs_tele
 from ..obs import trace as obs_trace
 from ..ops import registry as op_registry
@@ -493,6 +494,20 @@ class _CompiledProgram:
                 "aot": {},
             }
             self._jit_cache[i] = jitted
+            if flags.get_flag("xla_cost_attribution") \
+                    or obs_health.attribution_forced():
+                # the static half of the memory drift join: the
+                # segment's liveness activation peak, registered once
+                # per build under the same attribution gate whose
+                # publish_compile_stats call supplies the XLA half
+                try:
+                    obs_mem.register_segment_static(
+                        self._segment_label(i, seg), ops,
+                        seg["outputs"],
+                        program.desc.block(block_idx))
+                except Exception:
+                    _log.debug("mem static registration failed for "
+                               "segment %d", i, exc_info=True)
 
         mutated = jitted["mutated"]
         mut_ins = {n: v for n, v in in_vals.items() if n in mutated}
@@ -835,11 +850,16 @@ class Executor:
                                     use_program_cache, eager)
         except Exception as exc:
             # flight-recorder hook: a crashing run leaves a post-mortem
-            # bundle (no-op unless obs.flight.install() was called)
+            # bundle (no-op unless obs.flight.install() was called).
+            # An OOM-class failure (device RESOURCE_EXHAUSTED or the
+            # mem_budget_gb pre-flight) additionally carries the static
+            # timeline's top blamed buffers + the last mem_* gauges —
+            # oom_context is {} for everything else.
             obs_flight.on_crash(
                 exc, origin="executor/run",
                 feeds=obs_flight.describe_feeds(feed),
-                fetches=list(fetch_names), eager=bool(eager))
+                fetches=list(fetch_names), eager=bool(eager),
+                **obs_mem.oom_context(exc, program, fetch_names))
             raise
 
     def _run_traced(self, run_span, program, feed, fetch_names, scope,
@@ -886,18 +906,29 @@ class Executor:
 
                     program_to_compile, _ = passes_mod.optimize_program(
                         program, spec, fetches=list(fetch_names))
+                # OOM pre-flight (FLAGS_mem_budget_gb): refuse a
+                # program whose static peak busts the budget BEFORE
+                # any compile, on the program that will actually run
+                # (post-pass: auto_remat may have bought headroom).
+                # The MemoryBudgetError routes through the same OOM
+                # flight-bundle path a device RESOURCE_EXHAUSTED does.
+                budget = flags.get_flag("mem_budget_gb")
+                if budget:
+                    obs_mem.preflight(program_to_compile, fetch_names,
+                                      budget)
                 compiled = _CompiledProgram(self, program_to_compile, 0,
                                             sorted(feed_env.keys()),
                                             fetch_names)
                 if use_program_cache:
                     self._cache[key] = compiled
                     while len(self._cache) > self._CACHE_MAX:
-                        ekey, _ = self._cache.popitem(last=False)
+                        ekey, evicted = self._cache.popitem(last=False)
                         # LRU eviction was silent: a hot serving mix
                         # thrashing the program cache looked like
                         # random recompiles.  Count it and name the
                         # victim.
                         obs_tele.on_program_cache_evict()
+                        self._retire_segment_gauges(evicted)
                         _log.debug(
                             "evicted program cache entry: token=%s "
                             "version=%s feeds=%s fetches=%s",
@@ -905,11 +936,50 @@ class Executor:
             elif use_program_cache:
                 self._cache.move_to_end(key)
 
-            results = compiled.run(scope, feed_env, eager=eager)
+            try:
+                results = compiled.run(scope, feed_env, eager=eager)
+            except Exception as exc:
+                # a device OOM must be blamed on the program that
+                # ACTUALLY ran — under FLAGS_compile_passes that is
+                # the rewritten clone (auto_remat already dropped the
+                # buffers the original would name); run()'s flight
+                # hook reads this through oom_context
+                if obs_mem.is_oom(exc) \
+                        and not hasattr(exc, "_mem_program"):
+                    try:
+                        exc._mem_program = compiled.program
+                    except Exception:
+                        pass  # __slots__ exception: original blamed
+                raise
 
             if return_numpy:
                 results = [self._to_numpy(r) for r in results]
             return results
+
+    def _retire_segment_gauges(self, evicted):
+        """Per-segment gauges (`xla_*`/`mem_*{segment=}`) are
+        published at build time but were never RETIRED when the LRU
+        evicted their program — a long-lived serving process slowly
+        accumulated dead segment labels in /metrics.  Drop the
+        evicted program's labels through the registry's `remove()`
+        path — EXCEPT labels a still-cached program shares (labels
+        are shape-independent, so a structurally identical warm
+        program would never re-publish the removed child and its
+        live metrics would silently vanish for the process
+        lifetime)."""
+        try:
+            labels = {evicted._segment_label(i, seg)
+                      for i, seg in enumerate(evicted._plan)}
+            for other in self._cache.values():
+                labels.difference_update(
+                    other._segment_label(i, seg)
+                    for i, seg in enumerate(other._plan))
+            if labels:
+                obs_health.retire_compile_stats(labels)
+                obs_mem.retire_segments(labels)
+        except Exception:
+            _log.debug("segment gauge retirement failed",
+                       exc_info=True)
 
     def _verify_program(self, program, fetch_names):
         """FLAGS_verify_program path: full analysis once per (program

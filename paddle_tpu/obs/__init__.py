@@ -43,6 +43,12 @@ split into composable pieces instead of one table):
                  over the fluid/analysis roofline + XLA attribution),
                  and the perf-history regression gate behind `pperf`
                  (tools/perf_cli.py).
+  * `mem`      — HBM memory observability: the static liveness
+                 timeline (per-op live bytes, top buffers blamed to
+                 defining ops) vs XLA's measured `memory_analysis()`
+                 actuals, per-segment `mem_*` gauges + drift ratios,
+                 the buffer-donation audit, and OOM pre-flight /
+                 post-mortems behind `pmem` (tools/mem_cli.py).
 
 Everything is import-cheap and off by default: with tracing disabled a
 span is one attribute load + one `is` check, registry counters are
@@ -59,9 +65,10 @@ from . import telemetry
 from . import health
 from . import flight
 from . import perf
+from . import mem
 from . import context
 from . import tail
 from . import fleet
 
 __all__ = ["trace", "registry", "telemetry", "health", "flight",
-           "perf", "context", "tail", "fleet"]
+           "perf", "mem", "context", "tail", "fleet"]

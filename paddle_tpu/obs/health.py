@@ -47,8 +47,8 @@ from . import registry as registry_mod
 from . import telemetry as telemetry_mod
 
 __all__ = ["NumericsMonitor", "locate_nonfinite", "publish_compile_stats",
-           "scan_outputs", "enable", "disable", "enabled",
-           "force_attribution", "attribution_forced"]
+           "retire_compile_stats", "scan_outputs", "enable", "disable",
+           "enabled", "force_attribution", "attribution_forced"]
 
 _enabled = False
 
@@ -416,4 +416,26 @@ def publish_compile_stats(segment, compiled):
             reg.gauge(gauge, help_text, labelnames=("segment",)) \
                .labels(segment=segment).set(float(v))
             published[gauge] = float(v)
+    if published:
+        # the memory-observability side of the same capture: obs.mem
+        # stores the actuals for the static-vs-XLA drift join and the
+        # mem_* gauges (same best-effort contract as everything here)
+        from . import mem as mem_mod
+
+        try:
+            mem_mod.on_compile_captured(segment, published)
+        except Exception:
+            pass
     return published or None
+
+
+def retire_compile_stats(segments):
+    """Drop the per-segment xla_* gauge children for retired segment
+    labels (the program-cache LRU eviction path; obs.mem retires its
+    mem_* gauges through the same executor hook).  A label shared
+    with a still-cached program re-publishes on its next build."""
+    reg = registry_mod.get_registry()
+    for gauge, _src, help_text in _MEMORY_GAUGES + _COST_GAUGES:
+        fam = reg.gauge(gauge, help_text, labelnames=("segment",))
+        for segment in segments:
+            fam.remove(segment=segment)

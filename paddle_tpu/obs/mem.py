@@ -1,0 +1,836 @@
+"""HBM memory observability: static liveness timeline vs XLA actuals,
+buffer-donation audit, OOM pre-flight/post-mortems, and the drift
+calibration feed for `paddle_tpu.tune`.
+
+The repo *estimates* HBM in three places — the shard analyzer's S005
+per-device peaks, `ptune`'s budget rejections, `auto_remat`'s accept
+gate — but until this module nothing ever checked those predictions
+against what XLA actually allocates.  Five layers close the loop:
+
+  * **static timeline** — `program_timeline(program, fetches)` runs
+    the ONE shared liveness walk (`analysis.dataflow
+    .liveness_timeline`, the same accounting S005 and auto_remat use)
+    and returns the per-op live-activation-bytes series with the
+    top-N buffers resident at the peak, each blamed to its defining
+    op.  `render_timeline` draws it, `timeline_chrome_trace` exports
+    a Chrome-trace counter track ("ph": "C") co-loadable with the
+    obs.trace / obs.perf exports (its timebase is synthetic — one µs
+    per op index — so it loads as a profile shape, not wall time).
+  * **actuals capture** — the executor registers each jit segment's
+    static peak at first build (`register_segment_static`) and
+    `obs.health.publish_compile_stats` forwards the segment's
+    `compiled.memory_analysis()` numbers here
+    (`on_compile_captured`), riding the SAME attribution AOT artifact
+    that executes the step — no second compile.  Both land in
+    `mem_*{segment=}` gauges plus `jax.local_devices()` live-bytes
+    watermarks (`mem_device_*{device=}`; CPU backends report none —
+    graceful).
+  * **drift report** — `drift_report()` joins static peak vs XLA
+    temp+output bytes per segment, publishes
+    `mem_estimate_ratio{segment=}`, and `calibration_blob()` distills
+    the median actual/static ratio into a JSON blob
+    `tune.fit.load_hbm_calibration` feeds back into `ptune plan`
+    (`rank(..., hbm_ratio=)`) — the HBM term stops being purely
+    analytic.
+  * **donation audit** — `audit_donation(program)` walks the
+    registry's `in_place_outputs` declarations against the signature
+    the executor will actually donate (`mutated = outputs ∩ reads`
+    per jit segment) and reports param/optimizer-state buffers that
+    are dead-after-use but NOT donated (forked slots, dropped
+    aliases, updates stranded in non-jittable segments), with the
+    bytes reclaimable — the measurement half of the buffer-donation
+    work (docs/PERF.md).
+  * **OOM pre-flight + post-mortem** — `FLAGS_mem_budget_gb` makes
+    the executor refuse to compile a program whose static peak busts
+    the budget (`preflight` raises `MemoryBudgetError`, an honest
+    pre-device RESOURCE_EXHAUSTED), and `oom_context(exc, program)`
+    attaches the timeline's top blamed buffers + the last `mem_*`
+    gauges to the PR 3 flight bundle for both the pre-flight error
+    and a real device RESOURCE_EXHAUSTED (`obs_dump --flight`
+    renders the blame table).
+
+Import-cheap by design: fluid/analysis are imported lazily inside
+functions, same contract as obs.health — `paddle_tpu.obs` stays free
+of framework import cycles.  `tools/mem_cli.py` ("pmem") is the
+operator surface; docs/OBSERVABILITY.md "Memory" has the runbook.
+"""
+
+import json
+import os
+import threading
+import time
+
+from . import registry as registry_mod
+from . import telemetry as telemetry_mod
+
+__all__ = ["program_timeline", "segment_static_peak",
+           "render_timeline", "timeline_chrome_trace",
+           "register_segment_static", "on_compile_captured",
+           "retire_segments", "segments", "xla_program_bytes_total",
+           "device_watermarks", "publish_device_watermarks",
+           "record_bucket_bytes", "health_memory_section",
+           "drift_report", "render_drift", "calibration_blob",
+           "save_calibration", "dump_store", "load_store",
+           "audit_donation", "render_audit",
+           "MemoryBudgetError", "preflight", "is_oom", "oom_context",
+           "bench_memory_blob", "MEM_CALIBRATION_KIND"]
+
+MEM_CALIBRATION_KIND = "paddle_tpu.mem_calibration"
+GiB = float(1 << 30)
+MiB = float(1 << 20)
+
+_lock = threading.Lock()
+# segment label -> {"static_peak_bytes", "static_peak_op",
+#   "top_buffers", "xla": {...}} — the drift join's left and right
+# sides, keyed exactly like the executor's xla_* gauges
+_segments = {}
+# serving bucket -> xla program bytes its warmup compiles added
+_bucket_bytes = {}
+
+
+def _reg():
+    return registry_mod.get_registry()
+
+
+def _seg_gauge(name, help_text):
+    return _reg().gauge(name, help_text, labelnames=("segment",))
+
+
+# ---------------------------------------------------------------------------
+# static timeline
+# ---------------------------------------------------------------------------
+
+def _bf16_act_now():
+    from ..utils import flags
+
+    return bool(flags.get_flag("amp_bf16")
+                and flags.get_flag("amp_bf16_act"))
+
+
+def _byte_policies(bd, bf16_act=None):
+    """(activation_bytes, persistable_bytes) name->bytes policies over
+    one block's VarDescs: activations at amp element sizes (dynamic
+    dims count 1 — a floor, same as S005), persistables at full
+    storage size (masters stay f32)."""
+    from ..fluid import analysis as fluid_analysis
+
+    if bf16_act is None:
+        bf16_act = _bf16_act_now()
+
+    def act_bytes(name):
+        vd = bd.vars.get(name)
+        if vd is None or vd.persistable or vd.shape is None:
+            return 0
+        return fluid_analysis._numel(vd.shape) * \
+            fluid_analysis._elem_bytes(str(vd.dtype), False, bf16_act)
+
+    def persist_bytes(name):
+        vd = bd.vars.get(name)
+        if vd is None or not vd.persistable or vd.shape is None:
+            return 0
+        return fluid_analysis._numel(vd.shape) * \
+            fluid_analysis._elem_bytes(str(vd.dtype), True, bf16_act)
+
+    return act_bytes, persist_bytes
+
+
+def program_timeline(program, fetches=None, top_n=8, bf16_act=None):
+    """The static memory timeline of a Program's block 0: per-op live
+    activation bytes (the liveness series), the constant
+    params+state floor, and the top-N buffers resident at the peak
+    blamed to their defining ops.  Pure IR walk — zero devices."""
+    from ..analysis.dataflow import liveness_timeline
+
+    desc = getattr(program, "desc", program)
+    bd = desc.block(0)
+    act_bytes, persist_bytes = _byte_policies(bd, bf16_act)
+    final_live = {n for n, vd in bd.vars.items() if vd.persistable}
+    final_live |= set(fetches or ())
+    tl = liveness_timeline(bd.ops, act_bytes, final_live,
+                           top_n=top_n)
+    params = sum(persist_bytes(n) for n in bd.vars)
+    peak_op = tl["peak_op"]
+    return {
+        "kind": "paddle_tpu.mem_timeline",
+        "version": 1,
+        "ops": len(bd.ops),
+        "op_types": [od.type for od in bd.ops],
+        "series": tl["series"],
+        "peak_bytes": int(tl["peak_bytes"]),
+        "peak_op": peak_op,
+        "peak_op_type": (bd.ops[peak_op].type
+                         if peak_op is not None else None),
+        "params_bytes": int(params),
+        "total_peak_bytes": int(params + tl["peak_bytes"]),
+        "top_buffers": tl["top_buffers"],
+    }
+
+
+def segment_static_peak(op_descs, outputs, block_desc, top_n=5,
+                        bf16_act=None):
+    """Static live-activation peak over ONE executor jit segment's
+    ops, with the segment's outputs as the final live set — the
+    apples-to-apples comparand for that segment's XLA temp+output
+    bytes (arguments live outside the walk, exactly like feeds)."""
+    from ..analysis.dataflow import liveness_timeline
+
+    act_bytes, _ = _byte_policies(block_desc, bf16_act)
+    return liveness_timeline(op_descs, act_bytes, set(outputs or ()),
+                             top_n=top_n)
+
+
+def render_timeline(tl, width=48, max_rows=64):
+    """ASCII render of a timeline: one bar per op (downsampled past
+    `max_rows`), the peak row marked, then the blamed top buffers."""
+    lines = ["memory timeline: %d op(s), params+state %.1f MiB, "
+             "activation peak %.1f MiB at op %s (%s), total peak "
+             "%.1f MiB"
+             % (tl["ops"], tl["params_bytes"] / MiB,
+                tl["peak_bytes"] / MiB, tl["peak_op"],
+                tl["peak_op_type"], tl["total_peak_bytes"] / MiB)]
+    series = tl["series"]
+    if series:
+        peak = max(max(series), 1)
+        n = len(series)
+        stride = max(1, -(-n // int(max_rows)))
+        for start in range(0, n, stride):
+            chunk = series[start:start + stride]
+            val = max(chunk)
+            bar = "#" * max(1, int(round(val / peak * width))) \
+                if val else ""
+            marker = " <- peak" if (tl["peak_op"] is not None
+                                    and start <= tl["peak_op"]
+                                    < start + stride) else ""
+            label = ("op %d" % start if stride == 1
+                     else "op %d-%d" % (start, start + len(chunk) - 1))
+            lines.append("  %-12s %8.1f MiB |%-*s|%s"
+                         % (label, val / MiB, width, bar, marker))
+    if tl["top_buffers"]:
+        lines.append("top buffers live at the peak:")
+        for b in tl["top_buffers"]:
+            lines.append("  %-44s %10.2f MiB  def op %-4s %s"
+                         % (b["name"], b["bytes"] / MiB,
+                            b["def_op"], b["def_op_type"] or "-"))
+    return "\n".join(lines)
+
+
+def timeline_chrome_trace(tl, path=None, name="mem_live_bytes"):
+    """The timeline as a Chrome trace-event counter track ("ph": "C")
+    plus one span per op, co-loadable with the obs.trace / obs.perf
+    exports in Perfetto.  The timebase is SYNTHETIC — one µs per op
+    index (a static walk has no wall clock) — so it reads as a
+    profile shape next to the real tracks, not as wall time."""
+    evs = [{"name": "process_name", "ph": "M", "pid": 3, "tid": 0,
+            "args": {"name": "paddle_tpu.obs.mem (static, 1us/op)"}}]
+    for i, val in enumerate(tl["series"]):
+        evs.append({"name": name, "cat": "mem", "ph": "C", "pid": 3,
+                    "tid": 1, "ts": float(i),
+                    "args": {"live_bytes": int(val)}})
+        evs.append({"name": tl["op_types"][i], "cat": "mem", "ph": "X",
+                    "pid": 3, "tid": 1, "ts": float(i), "dur": 1.0,
+                    "args": {"op_index": i, "live_bytes": int(val)}})
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms",
+           "otherData": {"producer": "paddle_tpu.obs.mem",
+                         "peak_bytes": int(tl["peak_bytes"]),
+                         "peak_op": tl["peak_op"],
+                         "params_bytes": int(tl["params_bytes"])}}
+    if path:
+        tmp = str(path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, str(path))
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# actuals capture (executor wiring)
+# ---------------------------------------------------------------------------
+
+def register_segment_static(segment, op_descs, outputs, block_desc):
+    """Executor hook, first build of a jit segment under attribution:
+    record the segment's static activation peak + blamed buffers and
+    publish `mem_static_peak_bytes{segment=}`.  The later
+    `on_compile_captured` call for the same label completes the
+    drift join."""
+    tl = segment_static_peak(op_descs, outputs, block_desc)
+    entry = {"static_peak_bytes": int(tl["peak_bytes"]),
+             "static_peak_op": tl["peak_op"],
+             "top_buffers": tl["top_buffers"],
+             "captured_at": time.time()}
+    with _lock:
+        _segments.setdefault(segment, {}).update(entry)
+    _seg_gauge("mem_static_peak_bytes",
+               "static liveness activation-peak bytes per compiled "
+               "segment (obs.mem)") \
+        .labels(segment=segment).set(entry["static_peak_bytes"])
+    return entry
+
+
+def on_compile_captured(segment, published):
+    """obs.health hook: `published` is publish_compile_stats' dict of
+    xla_* values for one compiled executable.  Stores the actuals
+    side of the drift join, publishes `mem_xla_program_bytes` (temp +
+    output — what the program itself allocates beyond its arguments)
+    and, when the static side is already registered,
+    `mem_estimate_ratio{segment=}` (XLA actual / static estimate)."""
+    xla = {k: v for k, v in (published or {}).items()
+           if k.startswith("xla_")}
+    if not xla:
+        return None
+    program_bytes = int(xla.get("xla_temp_bytes", 0)
+                        + xla.get("xla_output_bytes", 0))
+    with _lock:
+        entry = _segments.setdefault(segment, {})
+        entry["xla"] = xla
+        entry["xla_program_bytes"] = program_bytes
+        entry["captured_at"] = time.time()
+        static = entry.get("static_peak_bytes")
+    _seg_gauge("mem_xla_program_bytes",
+               "XLA temp+output bytes per compiled segment (what the "
+               "program allocates beyond its arguments)") \
+        .labels(segment=segment).set(program_bytes)
+    if xla.get("xla_argument_bytes") is not None:
+        _seg_gauge("mem_xla_argument_bytes",
+                   "XLA argument bytes per compiled segment") \
+            .labels(segment=segment) \
+            .set(int(xla["xla_argument_bytes"]))
+    if static:
+        _seg_gauge("mem_estimate_ratio",
+                   "XLA actual temp+output bytes / static "
+                   "liveness-peak estimate per segment (1.0 = the "
+                   "static model is exact)") \
+            .labels(segment=segment) \
+            .set(round(program_bytes / static, 6))
+    publish_device_watermarks()
+    return program_bytes
+
+
+_SEG_GAUGES = ("mem_static_peak_bytes", "mem_xla_program_bytes",
+               "mem_xla_argument_bytes", "mem_estimate_ratio")
+
+
+def retire_segments(labels):
+    """Drop per-segment mem_* gauge children and store entries for
+    retired segments (program-cache LRU eviction): a long-lived
+    serving process must not accumulate dead segment labels.  A label
+    shared with a still-live program re-publishes on its next
+    build."""
+    reg = _reg()
+    with _lock:
+        for label in labels:
+            _segments.pop(label, None)
+    for name in _SEG_GAUGES:
+        fam = reg.gauge(name, labelnames=("segment",))
+        for label in labels:
+            fam.remove(segment=label)
+
+
+def segments():
+    """Snapshot of the per-segment store (static + xla sides)."""
+    with _lock:
+        return {k: dict(v) for k, v in _segments.items()}
+
+
+def xla_program_bytes_total():
+    """Sum of captured XLA temp+output bytes across all live
+    segments (the serving warmup's per-bucket delta base)."""
+    with _lock:
+        return sum(int(v.get("xla_program_bytes", 0))
+                   for v in _segments.values())
+
+
+def reset():
+    """Clear the store (test isolation; gauges reset with the
+    registry)."""
+    with _lock:
+        _segments.clear()
+        _bucket_bytes.clear()
+
+
+def device_watermarks():
+    """{device: {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}}
+    from `jax.local_devices()[*].memory_stats()`.  Backends without
+    allocator stats (CPU) contribute nothing — graceful by
+    contract."""
+    out = {}
+    try:
+        import jax
+
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            out[str(dev)] = {
+                k: int(stats[src]) for k, src in
+                (("bytes_in_use", "bytes_in_use"),
+                 ("peak_bytes_in_use", "peak_bytes_in_use"),
+                 ("bytes_limit", "bytes_limit"))
+                if src in stats}
+    except Exception:
+        return {}
+    return out
+
+
+def publish_device_watermarks():
+    """Publish the watermarks as `mem_device_*{device=}` gauges;
+    returns the dict (empty on statless backends)."""
+    marks = device_watermarks()
+    if not marks:
+        return marks
+    reg = _reg()
+    for dev, stats in marks.items():
+        if "bytes_in_use" in stats:
+            reg.gauge("mem_device_bytes_in_use",
+                      "device allocator live bytes",
+                      labelnames=("device",)) \
+                .labels(device=dev).set(stats["bytes_in_use"])
+        if "peak_bytes_in_use" in stats:
+            reg.gauge("mem_device_peak_bytes",
+                      "device allocator peak live bytes (high "
+                      "watermark)", labelnames=("device",)) \
+                .labels(device=dev).set(stats["peak_bytes_in_use"])
+    return marks
+
+
+def record_bucket_bytes(bucket, nbytes):
+    """Serving warmup hook: the XLA temp+output footprint of one
+    batch bucket's warmed executables, as
+    `mem_bucket_xla_bytes{bucket=}` (the /healthz "memory" section
+    reads these back).  The engine passes the store total measured
+    right after the bucket's warmup — segment labels are
+    shape-independent and each bucket recompiles every jittable
+    segment, so at that instant the store IS the bucket's program."""
+    nbytes = max(0, int(nbytes))
+    with _lock:
+        _bucket_bytes[str(bucket)] = nbytes
+    _reg().gauge("mem_bucket_xla_bytes",
+                 "XLA temp+output bytes of each serving batch "
+                 "bucket's warmed executables",
+                 labelnames=("bucket",)) \
+        .labels(bucket=bucket).set(nbytes)
+    return nbytes
+
+
+def health_memory_section():
+    """The serving /healthz "memory" block: per-bucket warmup bytes +
+    device watermarks.  None when neither exists (nothing captured,
+    CPU backend) so the endpoint contract stays opt-in."""
+    with _lock:
+        buckets = dict(_bucket_bytes)
+    marks = device_watermarks()
+    if not buckets and not marks:
+        return None
+    section = {}
+    if buckets:
+        section["bucket_xla_bytes"] = buckets
+    if marks:
+        section["device"] = marks
+    return section
+
+
+# ---------------------------------------------------------------------------
+# drift report + calibration feed
+# ---------------------------------------------------------------------------
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    if not n:
+        return None
+    if n % 2:
+        return vals[n // 2]
+    return (vals[n // 2 - 1] + vals[n // 2]) / 2.0
+
+
+def drift_report(store=None):
+    """Join static peak vs XLA actual per segment.  `store` defaults
+    to this process's capture (`segments()`); pass a `load_store`
+    dict for offline joins.  Segments with only one side are listed
+    under "unjoined".  Publishes `mem_estimate_ratio{segment=}` for
+    every joined row."""
+    store = segments() if store is None else store
+    rows, unjoined = [], []
+    for segment in sorted(store):
+        e = store[segment]
+        static = e.get("static_peak_bytes")
+        actual = e.get("xla_program_bytes")
+        if static and actual is not None:
+            ratio = round(actual / static, 6) if static else None
+            rows.append({"segment": segment,
+                         "static_peak_bytes": int(static),
+                         "xla_program_bytes": int(actual),
+                         "ratio": ratio,
+                         "top_buffers": e.get("top_buffers", [])})
+            if ratio is not None:
+                _seg_gauge("mem_estimate_ratio",
+                           "XLA actual temp+output bytes / static "
+                           "liveness-peak estimate per segment (1.0 "
+                           "= the static model is exact)") \
+                    .labels(segment=segment).set(ratio)
+        else:
+            unjoined.append({"segment": segment,
+                             "has_static": bool(static),
+                             "has_actual": actual is not None})
+    ratios = [r["ratio"] for r in rows if r["ratio"]]
+    return {"kind": "paddle_tpu.mem_drift", "version": 1,
+            "segments": rows, "unjoined": unjoined,
+            "n": len(ratios), "median_ratio": _median(ratios),
+            "device": device_watermarks() or None}
+
+
+def render_drift(report):
+    lines = ["memory drift: %d joined segment(s), %d unjoined, "
+             "median actual/static ratio %s"
+             % (len(report["segments"]), len(report["unjoined"]),
+                ("%.3f" % report["median_ratio"])
+                if report["median_ratio"] else "n/a")]
+    lines.append("  %-44s %12s %12s %8s"
+                 % ("segment", "static MiB", "xla MiB", "ratio"))
+    for r in report["segments"]:
+        lines.append("  %-44s %12.2f %12.2f %8s"
+                     % (r["segment"],
+                        r["static_peak_bytes"] / MiB,
+                        r["xla_program_bytes"] / MiB,
+                        ("%.3f" % r["ratio"]) if r["ratio"] else "-"))
+    for u in report["unjoined"]:
+        side = "static only" if u["has_static"] else "actual only"
+        lines.append("  %-44s (%s — no join)" % (u["segment"], side))
+    if report.get("device"):
+        for dev, stats in sorted(report["device"].items()):
+            lines.append("  device %s: %.1f MiB in use, peak %.1f MiB"
+                         % (dev,
+                            stats.get("bytes_in_use", 0) / MiB,
+                            stats.get("peak_bytes_in_use", 0) / MiB))
+    return "\n".join(lines)
+
+
+def calibration_blob(report, model=None):
+    """The drift report distilled into the blob `ptune` consumes
+    (`tune.fit.load_hbm_calibration` -> `rank(..., hbm_ratio=)`):
+    the median measured actual/static ratio scales the static HBM
+    peak before the S005 budget check, so the tuner's HBM term stops
+    being purely analytic.  None when nothing joined."""
+    if not report.get("n"):
+        return None
+    return {"kind": MEM_CALIBRATION_KIND, "version": 1,
+            "hbm_ratio": report["median_ratio"], "n": report["n"],
+            "model": model,
+            "segments": {r["segment"]: r["ratio"]
+                         for r in report["segments"] if r["ratio"]}}
+
+
+def save_calibration(blob, path):
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(blob, f, indent=1, sort_keys=True)
+    os.replace(tmp, str(path))
+    return str(path)
+
+
+def dump_store(path):
+    """Persist this process's capture store for an offline
+    `pmem drift --store` join (atomic write)."""
+    doc = {"kind": "paddle_tpu.mem_store", "version": 1,
+           "segments": segments(),
+           "device": device_watermarks() or None,
+           "created_at": time.time()}
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, str(path))
+    return str(path)
+
+
+def load_store(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("kind") != "paddle_tpu.mem_store":
+        raise ValueError("%s is not a pmem store dump (kind=%r)"
+                         % (path, doc.get("kind")))
+    return doc["segments"]
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+def audit_donation(program, fetches=()):
+    """Walk the optimizer's `in_place_outputs` declarations against
+    the jit signature the executor will actually donate, and report
+    param/optimizer-state buffers that are dead-after-use but NOT
+    donated, with the bytes reclaimable.
+
+    The executor donates exactly `mutated = outputs ∩ reads` of each
+    jittable segment (`_CompiledProgram._run_jit_segment`,
+    donate_argnums=(0,)) — an in-place update that writes the SAME
+    var name it reads donates for free.  What leaks:
+
+      * a forked slot (H003 class): `Moment1Out` writing a different
+        var than `Moment1` — the old state buffer is dead after the
+        op but XLA sees two distinct buffers, no donation;
+      * a dropped alias: a declared in-place out slot missing from
+        the op entirely, stranding the input buffer;
+      * an update stranded in a non-jittable segment (host op in the
+        chain): eager execution never donates.
+
+    Returns {"donated": [...], "reclaimable": [...]} entries with
+    name/bytes/op identity; `reclaimable_bytes` is the audit's
+    headline number."""
+    from ..analysis.dataflow import Liveness, _in_place_pairs
+    from ..fluid import analysis as fluid_analysis
+    from ..fluid.executor import _segment_block
+
+    desc = getattr(program, "desc", program)
+    bd = desc.block(0)
+    bf16_act = _bf16_act_now()
+
+    def full_bytes(name):
+        vd = bd.vars.get(name)
+        if vd is None or vd.shape is None:
+            return 0
+        return fluid_analysis._numel(vd.shape) * \
+            fluid_analysis._elem_bytes(str(vd.dtype), True, bf16_act)
+
+    def kind_of(name, slot):
+        vd = bd.vars.get(name)
+        if vd is not None and vd.is_parameter:
+            return "param"
+        if slot == "ParamOut":
+            return "param"
+        if vd is not None and vd.persistable:
+            return "optimizer_state"
+        return "activation"
+
+    lv = Liveness(bd.ops, final_live=set(fetches or ())).analyze()
+    use_sites = lv.use_sites()
+    segments_plan = _segment_block(bd.ops)
+
+    donated, reclaimable = [], []
+    base = 0
+    for jit_ok, ops in segments_plan:
+        # replicate the executor's per-segment signature: writes that
+        # leave the segment (read later or persistable) are outputs,
+        # and outputs ∩ reads is the donated set
+        reads, writes = set(), set()
+        for od in ops:
+            reads.update(od.input_names())
+            writes.update(n for n in od.output_names()
+                          if n != "@EMPTY@")
+        end = base + len(ops)
+        needed_later = set(fetches or ())
+        for od in bd.ops[end:]:
+            needed_later.update(od.input_names())
+        persist = {n for n in writes
+                   if bd.vars.get(n) is not None
+                   and bd.vars[n].persistable}
+        outputs = {n for n in writes
+                   if n in needed_later or n in persist}
+        mutated = outputs & reads if jit_ok else set()
+
+        for off, od in enumerate(ops):
+            op_idx = base + off
+            for out_slot, in_slot in _in_place_pairs(od):
+                outs = od.output(out_slot)
+                ins = od.input(in_slot) if in_slot else []
+                for k, in_name in enumerate(ins):
+                    if in_name == "@EMPTY@":
+                        continue
+                    out_name = outs[k] if k < len(outs) else None
+                    nbytes = full_bytes(in_name)
+                    item = {"name": in_name, "bytes": int(nbytes),
+                            "op_index": op_idx, "op_type": od.type,
+                            "slot": out_slot,
+                            "kind": kind_of(in_name, out_slot)}
+                    if out_name == in_name and in_name in mutated:
+                        donated.append(item)
+                        continue
+                    # old value dead after this op?  (a later reader
+                    # would legitimately pin the buffer)
+                    later_reads = [u for u in
+                                   use_sites.get(in_name, ())
+                                   if u > op_idx]
+                    if later_reads or in_name in (fetches or ()):
+                        continue
+                    if out_name == in_name and not jit_ok:
+                        item["reason"] = (
+                            "in-place update runs in a non-jittable "
+                            "segment — eager execution never donates")
+                    elif out_name is None:
+                        item["reason"] = (
+                            "declared in-place slot %r is absent from "
+                            "the op; the input buffer is stranded"
+                            % out_slot)
+                    elif out_name != in_name:
+                        item["reason"] = (
+                            "in-place slot %r forks %r -> %r; XLA "
+                            "sees two buffers, no donation"
+                            % (out_slot, in_name, out_name))
+                    else:
+                        # same name but not in the donated signature
+                        # (not an output of its segment): dead write,
+                        # nothing to reclaim
+                        continue
+                    reclaimable.append(item)
+        base = end
+    return {
+        "kind": "paddle_tpu.mem_donation_audit", "version": 1,
+        "ops": len(bd.ops), "jit_segments": sum(
+            1 for j, _ in segments_plan if j),
+        "donated": donated,
+        "donated_bytes": sum(d["bytes"] for d in donated),
+        "reclaimable": reclaimable,
+        "reclaimable_bytes": sum(r["bytes"] for r in reclaimable),
+    }
+
+
+def render_audit(audit):
+    lines = ["donation audit: %d op(s) in %d jit segment(s); "
+             "%d buffer(s) donated (%.1f MiB), %d reclaimable "
+             "(%.1f MiB)"
+             % (audit["ops"], audit["jit_segments"],
+                len(audit["donated"]), audit["donated_bytes"] / MiB,
+                len(audit["reclaimable"]),
+                audit["reclaimable_bytes"] / MiB)]
+    for r in audit["reclaimable"]:
+        lines.append("  RECLAIM %-36s %10.2f MiB  [%s] op %d %s/%s"
+                     % (r["name"], r["bytes"] / MiB, r["kind"],
+                        r["op_index"], r["op_type"], r["slot"]))
+        lines.append("          %s" % r["reason"])
+    if not audit["reclaimable"]:
+        lines.append("  every dead-after-use param/state buffer is "
+                     "donated — nothing to reclaim")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# OOM pre-flight + post-mortem
+# ---------------------------------------------------------------------------
+
+class MemoryBudgetError(MemoryError):
+    """Raised by the pre-flight check (`FLAGS_mem_budget_gb`) before
+    any compile: the honest, pre-device RESOURCE_EXHAUSTED.  Carries
+    `.timeline` so the flight-bundle context never recomputes the
+    walk."""
+
+    def __init__(self, message, timeline=None, budget_gb=None):
+        super().__init__(message)
+        self.timeline = timeline
+        self.budget_gb = budget_gb
+
+
+def preflight(program, fetches, budget_gb):
+    """Refuse a program whose static total peak (params + optimizer
+    state + liveness activation peak) exceeds `budget_gb` GiB.  The
+    error message names the top blamed buffers — the same table a
+    real device OOM's flight bundle carries."""
+    tl = program_timeline(program, fetches=fetches, top_n=8)
+    total = tl["total_peak_bytes"]
+    budget = float(budget_gb) * GiB
+    if total <= budget:
+        return tl
+    top = "; ".join("%s %.1f MiB (op %s %s)"
+                    % (b["name"], b["bytes"] / MiB, b["def_op"],
+                       b["def_op_type"])
+                    for b in tl["top_buffers"][:3])
+    raise MemoryBudgetError(
+        "RESOURCE_EXHAUSTED (pre-flight): static peak HBM %.3f GiB "
+        "(params+state %.3f + activation peak %.3f at op %s %s) "
+        "exceeds FLAGS_mem_budget_gb=%.3g%s"
+        % (total / GiB, tl["params_bytes"] / GiB,
+           tl["peak_bytes"] / GiB, tl["peak_op"], tl["peak_op_type"],
+           float(budget_gb),
+           "" if not top else " — top resident: " + top),
+        timeline=tl, budget_gb=float(budget_gb))
+
+
+def is_oom(exc):
+    """True for device RESOURCE_EXHAUSTED errors and the pre-flight
+    MemoryBudgetError — the class whose flight bundles carry the
+    blamed-buffer table."""
+    if isinstance(exc, MemoryBudgetError):
+        return True
+    if isinstance(exc, MemoryError):
+        return True
+    return "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def oom_context(exc, program=None, fetches=None):
+    """Flight-bundle context for an OOM-class exception: `{}` for
+    anything else (the executor splats this into `on_crash`, so the
+    hot exception path stays one is_oom check).  The "oom" note
+    carries the static timeline's top blamed buffers and the last
+    mem_*/xla_* gauge values — the post-mortem names WHICH buffers
+    were resident instead of just "out of memory"."""
+    if not is_oom(exc):
+        return {}
+    tl = getattr(exc, "timeline", None)
+    # the executor annotates a device OOM with the program that
+    # ACTUALLY ran (the post-pass rewrite) — prefer it over the
+    # caller's original so the blame table matches reality
+    program = getattr(exc, "_mem_program", None) or program
+    if tl is None and program is not None:
+        try:
+            tl = program_timeline(program, fetches=fetches, top_n=8)
+        except Exception:
+            tl = None
+    gauges = {k: v for k, v in telemetry_mod.snapshot().items()
+              if k.startswith(("mem_", "xla_"))}
+    oom = {"reason": "resource_exhausted"}
+    if tl is not None:
+        oom.update({
+            "static_peak_bytes": tl["peak_bytes"],
+            "params_bytes": tl["params_bytes"],
+            "total_peak_bytes": tl["total_peak_bytes"],
+            "peak_op": tl["peak_op"],
+            "peak_op_type": tl["peak_op_type"],
+            "top_buffers": tl["top_buffers"],
+        })
+    if gauges:
+        oom["mem_gauges"] = gauges
+    marks = device_watermarks()
+    if marks:
+        oom["device"] = marks
+    return {"oom": oom}
+
+
+# ---------------------------------------------------------------------------
+# bench blob
+# ---------------------------------------------------------------------------
+
+def bench_memory_blob(program, fetches=(), xla_stats=None):
+    """The BENCH-record "memory" blob for one leg: static peak, the
+    AOT artifact's XLA temp/arg/output bytes (bench.py's
+    publish_compile_stats capture), the device watermark, and the
+    estimate ratio — XLA total footprint / static total, the SAME
+    actual/static direction as `mem_estimate_ratio` and the
+    calibration blob (1.0 = the static model is exact).  Never
+    raises contractually at the bench call site (wrapped there)."""
+    tl = program_timeline(program, fetches=fetches, top_n=3)
+    xla = xla_stats or {}
+    blob = {
+        "static_peak_bytes": tl["total_peak_bytes"],
+        "activation_peak_bytes": tl["peak_bytes"],
+        "params_bytes": tl["params_bytes"],
+        "top_buffers": tl["top_buffers"],
+    }
+    for key in ("xla_temp_bytes", "xla_argument_bytes",
+                "xla_output_bytes"):
+        if xla.get(key) is not None:
+            blob[key] = int(xla[key])
+    xla_total = sum(blob.get(k, 0) for k in
+                    ("xla_temp_bytes", "xla_argument_bytes",
+                     "xla_output_bytes"))
+    if xla_total and blob["static_peak_bytes"]:
+        blob["xla_total_bytes"] = xla_total
+        blob["estimate_ratio"] = round(
+            xla_total / blob["static_peak_bytes"], 4)
+    elif xla_total:
+        blob["xla_total_bytes"] = xla_total
+    marks = device_watermarks()
+    if marks:
+        blob["device_peak_bytes"] = max(
+            s.get("peak_bytes_in_use", 0) for s in marks.values())
+    return blob

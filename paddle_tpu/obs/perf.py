@@ -555,6 +555,17 @@ def normalize_record(record, leg=None, ts=None):
     cc = record.get("compile_cache")
     if cc:
         norm["compile_cache"] = cc
+    mem = record.get("memory")
+    if mem:
+        # the HBM story, kept to the joinable numbers: static peak,
+        # XLA's measured footprint, the device watermark, and the
+        # estimate ratio — `pperf gate --mem-tolerance` regresses on
+        # these like it does on step_ms (obs/mem.py)
+        norm["memory"] = {
+            k: mem[k] for k in
+            ("static_peak_bytes", "xla_total_bytes",
+             "device_peak_bytes", "estimate_ratio")
+            if mem.get(k) is not None}
     cfg = record.get("config")
     if cfg:
         # the candidate point (mesh/pipeline/batch/micro-batch knobs)
@@ -678,9 +689,26 @@ def prune_stale_history(path, apply=False):
     return len(kept), dropped
 
 
+# peak-memory keys the gate may compare, best first: XLA's measured
+# whole-step footprint (bench's AOT capture — deterministic), the
+# static estimate, the device watermark.  The gate only ever compares
+# a candidate against baseline values of the SAME key — the keys
+# legitimately differ by the pinned static-vs-actual factor, so a
+# candidate that lost its AOT capture (bench's jit-dispatch fallback)
+# must never gate its static bytes against an XLA-bytes baseline.
+_MEM_KEYS = ("xla_total_bytes", "static_peak_bytes",
+             "device_peak_bytes")
+
+
+def _mem_peak(rec, key):
+    v = (rec.get("memory") or {}).get(key)
+    return float(v) if v else None
+
+
 def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                  tolerance=DEFAULT_TOLERANCE, metric_tolerance=None,
-                 step_tolerance=None, allow_stale=False, metrics=None):
+                 step_tolerance=None, allow_stale=False, metrics=None,
+                 mem_tolerance=None):
     """Noise-aware regression gate over history records.
 
     Per metric: the NEWEST record is the candidate; the baseline is
@@ -701,6 +729,12 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
       * step time: candidate step_ms above baseline * (1 + step tol)
         fails even when throughput squeaked by (batch-size changes can
         mask a per-step regression).
+      * peak memory (OPT-IN via `mem_tolerance`): candidate peak
+        bytes (`_mem_peak` off the record's "memory" blob) above
+        baseline * (1 + mem tol) fails — an HBM regression that
+        doesn't yet cost step time still eats the headroom the next
+        batch-size bump needs.  Records without memory blobs are
+        never failed on memory.
 
     `metrics`, when given, restricts gating to those metric names.
     """
@@ -784,6 +818,33 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                     "> %.1f%% tol)" % (cand["step_ms"], base_step,
                                        rise * 100, st_tol * 100)))
             failed = True
+        if not failed and mem_tolerance is not None:
+            # gate on the best key present in BOTH the candidate and
+            # at least one baseline record — one consistent quantity,
+            # never static-vs-XLA apples-to-oranges
+            for key in _MEM_KEYS:
+                cand_mem = _mem_peak(cand, key)
+                if cand_mem is None:
+                    continue
+                base_vals = [m for m in
+                             (_mem_peak(r, key) for r in window)
+                             if m is not None]
+                if not base_vals:
+                    continue
+                base_mem = _median(base_vals)
+                if cand_mem > base_mem * (1.0 + float(mem_tolerance)):
+                    rise = cand_mem / base_mem - 1.0
+                    result.failures.append(dict(
+                        base_info, kind="memory", value=cand_mem,
+                        baseline=round(base_mem, 0),
+                        n=len(base_vals),
+                        why="peak memory (%s) %.1f MiB vs baseline "
+                            "median %.1f MiB (+%.1f%% > %.1f%% tol)"
+                            % (key, cand_mem / 2**20,
+                               base_mem / 2**20, rise * 100,
+                               float(mem_tolerance) * 100)))
+                    failed = True
+                break
         if not failed:
             result.checked.append(dict(
                 base_info, value=cand.get("value"),

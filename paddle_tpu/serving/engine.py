@@ -406,6 +406,7 @@ class InferenceEngine:
                             max_delay=1.0, name="serving_warmup")
         saved_metrics, self.metrics = self.metrics, None
         warmed = 0
+        from ..obs import mem as obs_mem
         from ..obs import telemetry as obs_tele
 
         snap_before = obs_tele.snapshot()
@@ -417,6 +418,15 @@ class InferenceEngine:
                              for n, m in self._feed_meta.items()}
                     retry.call(self.run, feeds)
                     warmed += 1
+                    # this bucket's full XLA program footprint: its
+                    # warmup recompiled every jittable segment at the
+                    # bucket's shapes, so the capture store (segment
+                    # labels are shape-independent — last compile
+                    # wins) now reflects exactly this bucket's
+                    # executables.  /healthz "memory" reads the
+                    # per-bucket gauges back.
+                    obs_mem.record_bucket_bytes(
+                        bucket, obs_mem.xla_program_bytes_total())
         finally:
             self.metrics = saved_metrics
         # what this warmup cost and where the executables came from:

@@ -268,6 +268,14 @@ class InferenceServer:
             body["slo"] = {"model": self.config.model_name,
                            "objective_ms": self.config.slo_ms,
                            "target": self.config.slo_target}
+        # per-bucket warmup footprint + device live-bytes watermarks
+        # (obs.mem; absent when nothing was captured — CPU backends
+        # report no allocator stats, and warmup may be disabled)
+        from ..obs import mem as obs_mem
+
+        mem_section = obs_mem.health_memory_section()
+        if mem_section is not None:
+            body["memory"] = mem_section
         return body
 
     # -- request handling ---------------------------------------------------

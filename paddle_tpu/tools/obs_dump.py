@@ -160,8 +160,36 @@ def render_flight(doc, max_steps=8):
         tb = exc.get("traceback") or ""
         lines.extend("  " + l for l in tb.rstrip().splitlines()[-3:])
     for note in doc.get("notes", []):
-        ctx = {k: v for k, v in note.items() if k not in ("t", "origin")}
+        ctx = {k: v for k, v in note.items()
+               if k not in ("t", "origin", "oom")}
         lines.append("note [%s] %s" % (note.get("origin"), ctx))
+        oom = note.get("oom")
+        if oom:
+            # the obs.mem post-mortem: name WHICH buffers were
+            # resident, not just "out of memory"
+            if oom.get("total_peak_bytes") is not None:
+                lines.append(
+                    "  OOM post-mortem: static peak %.1f MiB "
+                    "(params+state %.1f + activations %.1f at op "
+                    "%s %s)"
+                    % (oom["total_peak_bytes"] / 2**20,
+                       oom.get("params_bytes", 0) / 2**20,
+                       oom.get("static_peak_bytes", 0) / 2**20,
+                       oom.get("peak_op"), oom.get("peak_op_type")))
+            for b in oom.get("top_buffers", [])[:5]:
+                lines.append("    %-40s %10.2f MiB  def op %s (%s)"
+                             % (b["name"], b["bytes"] / 2**20,
+                                b.get("def_op"),
+                                b.get("def_op_type")))
+            for k, v in sorted((oom.get("mem_gauges") or {}).items()):
+                lines.append("    gauge %s = %g" % (k, v))
+            for dev, stats in sorted((oom.get("device") or {}).items()):
+                lines.append("    device %s: %.1f MiB in use, peak "
+                             "%.1f MiB"
+                             % (dev,
+                                stats.get("bytes_in_use", 0) / 2**20,
+                                stats.get("peak_bytes_in_use", 0)
+                                / 2**20))
     steps = doc["steps"][-max_steps:]
     if steps:
         lines.append("last %d step(s):" % len(steps))
@@ -178,7 +206,7 @@ def render_flight(doc, max_steps=8):
     reg = doc.get("registry", {})
     interesting = {k: v for k, v in sorted(reg.items())
                    if k.startswith(("numerics_", "grad_global_norm",
-                                    "amp_loss_scale", "xla_",
+                                    "amp_loss_scale", "xla_", "mem_",
                                     "trainer_last_loss",
                                     "executor_jit_traces_total"))}
     lines.append("registry: %d metric sample(s)%s"

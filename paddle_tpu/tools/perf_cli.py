@@ -83,6 +83,12 @@ def parse_args(argv=None):
     p.add_argument("--step-tolerance", type=float, default=None,
                    help="gate: relative step_ms tolerance (defaults "
                         "to --tolerance)")
+    p.add_argument("--mem-tolerance", type=float, default=None,
+                   help="gate: OPT-IN relative peak-memory tolerance "
+                        "over the records' \"memory\" blobs "
+                        "(bench.py stamps them; obs/mem.py) — an HBM "
+                        "regression fails CI like a step-time one; "
+                        "omitted = memory is not gated")
     p.add_argument("--allow-stale", action="store_true",
                    help="gate: downgrade stale-platform hard fails "
                         "to skips")
@@ -232,7 +238,8 @@ def cmd_gate(args):
                    if args.tolerance is None else args.tolerance),
         step_tolerance=args.step_tolerance,
         allow_stale=args.allow_stale,
-        metrics=set(args.metric) if args.metric else None)
+        metrics=set(args.metric) if args.metric else None,
+        mem_tolerance=args.mem_tolerance)
     if args.json:
         print(json.dumps(result.to_dict(), sort_keys=True))
     else:

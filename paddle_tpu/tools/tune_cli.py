@@ -106,6 +106,11 @@ def parse_args(argv=None):
     p.add_argument("--calibration", default=None,
                    help="plan: rank with this fitted calibration; "
                         "fit: save the fitted calibration here")
+    p.add_argument("--hbm-calibration", default=None,
+                   help="plan: a `pmem drift --calibration-out` blob; "
+                        "its measured actual/static ratio scales the "
+                        "static HBM peak before the S005 budget check "
+                        "(tune.fit.load_hbm_calibration)")
     p.add_argument("--out", default=None,
                    help="plan: write the launch plan JSON here")
     p.add_argument("--topk", type=int, default=None,
@@ -168,6 +173,11 @@ def _rank_plan(args, extra_candidates=(), hbm_gb="arg"):
     calibration = None
     if args.calibration and os.path.exists(args.calibration):
         calibration = tune_rank.Calibration.load(args.calibration)
+    hbm_ratio = None
+    if getattr(args, "hbm_calibration", None):
+        from paddle_tpu.tune.fit import load_hbm_calibration
+
+        hbm_ratio = load_hbm_calibration(args.hbm_calibration)
     builder = tune_models.builder(args.model, image_size=args.image_size,
                                   class_dim=args.class_dim)
     # the EFFECTIVE builder knobs (CLI override or model default) ride
@@ -184,7 +194,7 @@ def _rank_plan(args, extra_candidates=(), hbm_gb="arg"):
         calibration=calibration, bf16_act=args.bf16,
         peak_tflops=args.peak_tflops, hbm_gbps=args.hbm_gbps,
         space_dict=space.to_dict(), skipped=space.skipped,
-        extra_context=extra_context)
+        extra_context=extra_context, hbm_ratio=hbm_ratio)
 
 
 def cmd_plan(args):

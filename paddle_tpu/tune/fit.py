@@ -27,14 +27,40 @@ round-5 incident class; `pperf history --prune-stale` removes them
 from the file, and this module skips them even when it hasn't run.
 """
 
+import json
 import math
 
 from .rank import Calibration
 
 __all__ = ["join_history", "fit_calibration", "format_fit_report",
-           "LEG_PREFIX"]
+           "load_hbm_calibration", "LEG_PREFIX"]
 
 LEG_PREFIX = "ptune:"
+
+
+def load_hbm_calibration(path):
+    """Load a `pmem drift --calibration-out` blob
+    (obs/mem.calibration_blob) and return its measured
+    actual/static HBM ratio — the multiplier `rank(..., hbm_ratio=)`
+    applies to the static per-device peak before the S005 budget
+    check, so the tuner's HBM term carries XLA's measured footprint
+    instead of staying purely analytic.  Raises on a blob of the
+    wrong kind or a non-positive ratio (a corrupt calibration must
+    never silently widen the budget)."""
+    from ..obs.mem import MEM_CALIBRATION_KIND
+
+    with open(path) as f:
+        blob = json.load(f)
+    if blob.get("kind") != MEM_CALIBRATION_KIND:
+        raise ValueError(
+            "%s is not a pmem memory calibration (kind=%r; produce "
+            "one with `pmem drift --calibration-out`)"
+            % (path, blob.get("kind")))
+    ratio = float(blob.get("hbm_ratio") or 0.0)
+    if not math.isfinite(ratio) or ratio <= 0:
+        raise ValueError("memory calibration %s carries unusable "
+                         "hbm_ratio=%r" % (path, blob.get("hbm_ratio")))
+    return ratio
 
 
 def _plan_entries(plan):

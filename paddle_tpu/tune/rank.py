@@ -315,7 +315,7 @@ def _warning_counts(report):
 def rank(builder, candidates, chips, model=None, hbm_gb=None,
          calibration=None, bf16_act=True, peak_tflops=None,
          hbm_gbps=None, rules=None, space_dict=None, skipped=None,
-         extra_context=None,
+         extra_context=None, hbm_ratio=None,
          step_overhead_s=DEFAULT_STEP_OVERHEAD_S,
          micro_overhead_s=DEFAULT_MICRO_OVERHEAD_S):
     """Score every candidate statically and return a `RankedPlan`.
@@ -328,6 +328,11 @@ def rank(builder, candidates, chips, model=None, hbm_gb=None,
     chips: target device count; every candidate's mesh must multiply
         out to it (defense in depth for hand-built candidate lists).
     hbm_gb: per-device HBM budget; enables the S005 rejection.
+    hbm_ratio: measured XLA-actual/static HBM ratio from a `pmem
+        drift` calibration (`tune.fit.load_hbm_calibration`); scales
+        the static peak before the budget check so the HBM term is
+        no longer purely analytic.  None/1.0 keeps the analytic peak
+        (and the plan JSON byte-identical to pre-calibration runs).
     calibration: a fitted `Calibration` (identity when None).
     rules: optional match_partition_rules-style [(regex, spec), ...]
         forwarded to the sharding analyzer.
@@ -410,6 +415,12 @@ def rank(builder, candidates, chips, model=None, hbm_gb=None,
             + int(bd.get("optimizer_state_bytes", 0))
         act_scaled = act // m if m > 1 else act
         peak = fixed + act_scaled
+        if hbm_ratio and hbm_ratio != 1.0:
+            # measured drift calibration (obs/mem drift_report ->
+            # pmem --calibration-out): the static model historically
+            # under-counts XLA's real temp footprint; scale before
+            # the budget check so "fits" means fits on hardware
+            peak = int(peak * float(hbm_ratio))
         breakdown = {
             "params_bytes": int(bd.get("params_bytes", 0)),
             "optimizer_state_bytes": int(
@@ -417,15 +428,17 @@ def rank(builder, candidates, chips, model=None, hbm_gb=None,
             "activation_peak_bytes": act_scaled,
         }
         if hbm_gb is not None and peak > float(hbm_gb) * (1 << 30):
+            cal = ("" if not hbm_ratio or hbm_ratio == 1.0
+                   else ", x%.3g measured calibration" % hbm_ratio)
             rejected.append(Rejection(
                 cand, "S005", Severity.ERROR,
                 "static per-device peak HBM %.3f GiB (params %.3f + "
                 "optimizer state %.3f + activation peak %.3f at "
-                "micro_batches=%d) exceeds the %.3f GiB budget"
+                "micro_batches=%d%s) exceeds the %.3f GiB budget"
                 % (peak / 2**30,
                    breakdown["params_bytes"] / 2**30,
                    breakdown["optimizer_state_bytes"] / 2**30,
-                   act_scaled / 2**30, m, float(hbm_gb)),
+                   act_scaled / 2**30, m, cal, float(hbm_gb)),
                 peak_hbm_bytes=peak))
             continue
 
@@ -449,6 +462,8 @@ def rank(builder, candidates, chips, model=None, hbm_gb=None,
         "step_overhead_s": step_overhead_s,
         "micro_overhead_s": micro_overhead_s,
     }
+    if hbm_ratio and hbm_ratio != 1.0:
+        context["hbm_ratio"] = float(hbm_ratio)
     context.update(extra_context or {})
     if ranked:
         any_fl = next(iter(floors.values()))

@@ -100,6 +100,15 @@ DEFINE_flag("xla_cost_attribution", False,
             "segments it touched; serving warmup and mega_bench's "
             "non-risky legs enable it, the surfaces whose /metrics "
             "and BENCH artifacts consume the attribution")
+DEFINE_flag("mem_budget_gb", 0.0,
+            "OOM pre-flight (obs/mem.py): before compiling a program, "
+            "check its static peak-HBM estimate (params + optimizer "
+            "state + liveness activation peak — the S005 accounting) "
+            "against this many GiB and raise MemoryBudgetError naming "
+            "the top blamed buffers instead of letting the device "
+            "surface an opaque RESOURCE_EXHAUSTED; the failure routes "
+            "through the flight recorder like a real OOM.  0 (default) "
+            "disables")
 DEFINE_flag("verify_program", False,
             "run paddle_tpu.analysis verification on every program "
             "before its FIRST compile (per executor + program "

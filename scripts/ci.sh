@@ -40,6 +40,9 @@ timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 echo "[ci] pperf selftest (gate discriminates 20% regression + tpu-stale, step profiler ring/exports, loopback SLO burn, warm pcache blob) ..."
 timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 
+echo "[ci] pmem selftest (static timeline + counter track, static-vs-XLA drift join on lenet5 with calibration blob, donation audit finds a forked Adam slot, forced-tiny-budget OOM flight bundle blames the peak buffer) ..."
+timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
+
 echo "[ci] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, top-K measured with config blobs, calibration error shrinks) ..."
 timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
 

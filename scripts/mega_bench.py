@@ -117,7 +117,7 @@ CONFIGS = [
 _MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",
             "BENCH_HIDDEN", "BENCH_RECOMPUTE", "BENCH_LAYOUT",
             "BENCH_AMP", "BENCH_LEG", "BENCH_MESH",
-            "BENCH_MICRO_BATCH", "BENCH_PREFETCH",
+            "BENCH_MICRO_BATCH", "BENCH_PREFETCH", "BENCH_MEMORY",
             "FLAGS_amp_bf16_act", "FLAGS_fuse_optimizer",
             "FLAGS_bn_shifted_stats", "FLAGS_compile_passes")
 
@@ -253,6 +253,10 @@ def run_one_guarded(name, overrides, timeout):
         env.pop(k, None)
     env.update(overrides)
     env["BENCH_LEG"] = name  # names the leg in perf_history.jsonl
+    # the memory blob rides the same AOT capture as the perf blob —
+    # keep it (like attribution) away from the known-pathological
+    # googlenet compiles
+    env["BENCH_MEMORY"] = "0" if name in RISKY else "1"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     snap_before = obs_tele.snapshot()
     t0 = time.perf_counter()
@@ -297,6 +301,8 @@ def run_one(name, overrides):
         os.environ.pop(k, None)
     os.environ.update(overrides)
     os.environ["BENCH_LEG"] = name  # names the leg in perf_history
+    # memory blob on for the same legs that run attribution (below)
+    os.environ["BENCH_MEMORY"] = "0" if name in RISKY else "1"
     flags.parse_flags_from_env()
     for k in ("amp_bf16_act", "fuse_optimizer", "bn_shifted_stats",
               "compile_passes"):

@@ -44,6 +44,7 @@ setup(
             "paddle_trainer=paddle_tpu.tools.trainer_cli:main",
             "paddle_serve=paddle_tpu.tools.serve_cli:main",
             "pperf=paddle_tpu.tools.perf_cli:main",
+            "pmem=paddle_tpu.tools.mem_cli:main",
             "ptune=paddle_tpu.tools.tune_cli:main",
         ],
     },

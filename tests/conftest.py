@@ -38,6 +38,7 @@ def fresh_obs():
     assertions can't flake."""
     from paddle_tpu.obs import flight as obs_flight
     from paddle_tpu.obs import health as obs_health
+    from paddle_tpu.obs import mem as obs_mem
     from paddle_tpu.obs import perf as obs_perf
     from paddle_tpu.obs import registry as obs_registry
     from paddle_tpu.obs import tail as obs_tail
@@ -46,10 +47,12 @@ def fresh_obs():
     from paddle_tpu.resilience import faults as r_faults
 
     obs_registry.reset_registry()
+    obs_mem.reset()
     obs_trace.disable()
     obs_trace.reset()
     r_faults.disable()
     yield
+    obs_mem.reset()
     obs_health.disable()
     obs_flight.uninstall()
     obs_perf.uninstall()
