@@ -280,6 +280,14 @@ def _config_blob(model, mode, batch, micro, rcp, amp_bf16, pass_spec,
 
 
 def main():
+    if os.environ.get("BENCH_MULTICHIP"):
+        # MULTICHIP legs: SPMD scaling across mesh shapes (img/s +
+        # MFU + timed comm vs the plan's ring floor), records stamped
+        # with platform_class — paddle_tpu/spmd/bench.py owns the
+        # whole suite, including history appends
+        from paddle_tpu.spmd import bench as spmd_bench
+
+        raise SystemExit(spmd_bench.main_from_env())
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model not in _MODELS:
         raise SystemExit("BENCH_MODEL must be one of %s"

@@ -96,6 +96,30 @@ def enabled():
     return bool(flags.get_flag("compile_cache_dir"))
 
 
+def donation_aliasing_safe(backend=None):
+    """Whether `deserialize_and_load` preserves input-output aliasing
+    semantics for executables with DONATED inputs on this backend.
+
+    PjRt executable deserialization on the CPU backend has been
+    observed to mis-bind donated buffers — an output silently aliases
+    the wrong input and the loaded executable returns wrong values on
+    bit-identical inputs (the HLO's input_output_alias metadata looks
+    intact; the corruption is in the reloaded runtime binding).  Only
+    TPU, where the production compilation cache exercises exactly this
+    path, is trusted; everywhere else `get` treats donated entries as
+    misses and donating callers should cache a non-donating twin."""
+    import jax
+
+    try:
+        if backend is None or isinstance(backend, str):
+            platform = jax.devices(backend)[0].platform
+        else:
+            platform = backend.platform
+    except Exception:
+        return False
+    return str(platform).lower() == "tpu"
+
+
 def get_cache(root=None):
     """Process-wide cache for `root` (default: the flag dir); one
     instance per directory."""
@@ -208,6 +232,24 @@ class PersistentCache:
             self._quarantine(path, "deserialize")
             _misses().inc()
             return None
+        if not donation_aliasing_safe(backend):
+            import jax
+
+            donated = any(getattr(a, "donated", False) for a in
+                          jax.tree_util.tree_leaves(loaded.args_info))
+            if donated:
+                # silent-wrong-values hazard (see
+                # donation_aliasing_safe): recompiling is the only
+                # safe answer.  The entry stays on disk — it is not
+                # corrupt, and a trusted backend sharing the root can
+                # still use it.
+                _errors("donation").inc()
+                _log.warning("cache entry %s has donated inputs and "
+                             "this backend's executable reload does "
+                             "not preserve donation aliasing; "
+                             "treating as miss", path)
+                _misses().inc()
+                return None
         try:
             os.utime(path, None)  # LRU touch
         except OSError:

@@ -549,6 +549,12 @@ def normalize_record(record, leg=None, ts=None):
         "amp_bf16": record.get("amp_bf16"),
         "platform": record.get("platform"),
     }
+    if record.get("platform_class"):
+        norm["platform_class"] = record["platform_class"]
+    if record.get("n_devices"):
+        norm["n_devices"] = int(record["n_devices"])
+    if record.get("mesh"):
+        norm["mesh"] = dict(record["mesh"])
     if perf:
         norm["verdict"] = perf.get("verdict")
         norm["dominant"] = perf.get("dominant")
@@ -571,6 +577,14 @@ def normalize_record(record, leg=None, ts=None):
         # the candidate point (mesh/pipeline/batch/micro-batch knobs)
         # this record measured — the tuner's join key (tune/fit.py)
         norm["config"] = cfg
+    comm = record.get("comm")
+    if comm:
+        # multichip comm measurement (spmd/bench.py): the plan's
+        # analytic ring floor vs the timed grad-allreduce — the pair
+        # `ptune fit` prices the comm coefficient from
+        norm["comm"] = {
+            k: comm[k] for k in ("wire_bytes", "pred_s", "measured_s")
+            if comm.get(k) is not None}
     return norm
 
 
@@ -649,6 +663,30 @@ def is_stale_platform(platform):
 
 # internal alias (pre-existing callers)
 _is_stale_platform = is_stale_platform
+
+
+def platform_class(record):
+    """The measurement-comparability class of a history record:
+    platform + device count + mesh shape, e.g. ``cpu:d1``,
+    ``cpu:d8:dp=8``, ``tpu:d8:dp=4,mp=2``.
+
+    An 8-way CPU-simulated SPMD run and a single-chip TPU run must
+    never gate against each other or co-train the tuner's comm
+    calibration — same metric name, different physics.  Records that
+    predate the tag (no `platform_class`, `n_devices`, or `mesh`
+    field) derive ``<platform>:d1``, so a single-device history keeps
+    its whole baseline across the schema change."""
+    explicit = record.get("platform_class")
+    if explicit:
+        return str(explicit)
+    plat = str(record.get("platform") or "")
+    n = record.get("n_devices")
+    mesh = record.get("mesh")
+    cls = "%s:d%d" % (plat, int(n) if n else 1)
+    if mesh:
+        cls += ":" + ",".join("%s=%d" % (a, int(s))
+                              for a, s in sorted(dict(mesh).items()))
+    return cls
 
 
 def prune_stale_history(path, apply=False):
@@ -755,7 +793,8 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
         base_info = {"metric": metric, "leg": cand.get("leg"),
                      "verdict": cand.get("verdict"),
                      "dominant": cand.get("dominant"),
-                     "platform": cand.get("platform")}
+                     "platform": cand.get("platform"),
+                     "platform_class": platform_class(cand)}
         if _is_stale_platform(cand.get("platform")):
             if allow_stale:
                 result.skipped.append(dict(
@@ -768,17 +807,17 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                         "not a fresh measurement"
                         % cand.get("platform")))
             continue
+        cand_cls = platform_class(cand)
         matching = [r for r in prior
-                    if r.get("platform") == cand.get("platform")]
+                    if platform_class(r) == cand_cls]
         if not matching:
             if prior:
-                plats = sorted({str(r.get("platform"))
-                                for r in prior})
+                plats = sorted({platform_class(r) for r in prior})
                 result.failures.append(dict(
                     base_info, kind="platform",
-                    why="platform mismatch: candidate %r has no "
-                        "baseline (history is %s)"
-                        % (cand.get("platform"), ",".join(plats))))
+                    why="platform class mismatch: candidate %r has "
+                        "no baseline (history is %s)"
+                        % (cand_cls, ",".join(plats))))
             else:
                 result.skipped.append(dict(base_info,
                                            why="no baseline yet"))

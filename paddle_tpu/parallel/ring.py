@@ -24,7 +24,8 @@ from .sharding import shard_map_norep
 from ..kernels.flash_attention import flash_attention, NEG_INF
 
 __all__ = ["ring_attention", "ulysses_attention", "sp_shard_map",
-           "sp_axis_info"]
+           "sp_axis_info", "ring_allreduce", "grad_buckets",
+           "bucketed_allreduce"]
 
 
 def sp_axis_info(mesh, seq_len=None, n_heads=None, axis_name="sp",
@@ -150,6 +151,103 @@ def ulysses_attention(q, k, v, axis_name="sp", sm_scale=None,
 
         oh = reference_attention(qh, kh, vh, sm_scale, causal)
     return head2seq(oh)
+
+
+def ring_allreduce(x, axis_name="dp", mean=False):
+    """All-reduce `x` over `axis_name` as an explicit ring: a
+    reduce-scatter pass followed by an all-gather pass, each p-1
+    neighbor hops of 1/p of the payload over `lax.ppermute` (the ICI
+    neighbor exchange; reference: the gradient ring in
+    MultiGradientMachine.h:61-76).  Call inside shard_map.
+
+    Unlike `lax.psum` — which XLA lowers to one monolithic fused
+    all-reduce per use site — each call here is its own collective
+    chain, so bucketed callers (spmd/overlap.py) hand the scheduler
+    p-1 independent hops per bucket to overlap with remaining
+    backward compute.  Bandwidth-optimal: 2*(p-1)/p of the payload
+    crosses each link.
+    """
+    p = jax.lax.psum(1, axis_name)
+    if p == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    n = flat.size
+    m = -(-n // p)  # chunk size, padded up to a multiple of p
+    if m * p != n:
+        flat = jnp.pad(flat, (0, m * p - n))
+    chunks = flat.reshape(p, m)
+
+    # reduce-scatter: after p-1 hops device i owns the fully-reduced
+    # chunk (i+1) mod p
+    buf = jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+    for s in range(1, p):
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        j = (idx - s) % p
+        buf = buf + jax.lax.dynamic_index_in_dim(chunks, j, 0,
+                                                 keepdims=False)
+        chunks = jax.lax.dynamic_update_index_in_dim(chunks, buf, j, 0)
+    # all-gather: circulate the reduced chunks the rest of the way
+    for s in range(1, p):
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        chunks = jax.lax.dynamic_update_index_in_dim(
+            chunks, buf, (idx - s + 1) % p, 0)
+    out = chunks.reshape(-1)[:n]
+    if mean:
+        out = out / p
+    return out.reshape(shape).astype(dtype)
+
+
+def grad_buckets(sized_names, bucket_bytes):
+    """Group (name, nbytes) pairs into reduction buckets of at most
+    `bucket_bytes` each (always at least one name per bucket).  The
+    input order is preserved — callers pass grads in reverse
+    production order so the bucket holding the LAST-produced grads
+    reduces first, overlapping with the backward still computing the
+    earlier layers' grads (the DDP bucketing discipline)."""
+    buckets, cur, cur_bytes = [], [], 0
+    for name, nbytes in sized_names:
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(name)
+        cur_bytes += int(nbytes)
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_allreduce(grads, bucket_bytes, axis_name="dp",
+                       mean=True, order=None):
+    """Ring-allreduce a dict of per-device gradient shards in buckets.
+
+    Each bucket flattens and concatenates its members into one f32
+    vector, runs ONE `ring_allreduce` over it, and splits the result
+    back — one collective chain per bucket instead of one per tensor
+    (tiny grads amortize) or one for everything (no overlap).  `order`
+    (default: reversed dict order) fixes which grads reduce first.
+    """
+    if not grads:
+        return grads
+    names = list(order) if order is not None \
+        else list(reversed(list(grads)))
+    sized = [(n, grads[n].size * grads[n].dtype.itemsize)
+             for n in names]
+    out = dict(grads)
+    for bucket in grad_buckets(sized, bucket_bytes):
+        parts = [grads[n].astype(jnp.float32).reshape(-1)
+                 for n in bucket]
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        flat = ring_allreduce(flat, axis_name, mean=mean)
+        off = 0
+        for n in bucket:
+            size = grads[n].size
+            out[n] = flat[off:off + size].reshape(
+                grads[n].shape).astype(grads[n].dtype)
+            off += size
+    return out
 
 
 def sp_shard_map(fn, mesh, axis_name="sp", dp_axis="dp", mp_axis="mp"):

@@ -51,7 +51,7 @@ def verify_sharding(program, mesh, feed_names, fetch_names,
 def make_parallel_step(program, feed_names, fetch_names, mesh,
                        state_template, dp_axis="dp", mp_axis="mp",
                        donate_state=True, fp=None, zero_stage=0,
-                       feed_specs=None):
+                       feed_specs=None, spec_overrides=None):
     """Compile a Program block into a sharded step function.
 
     Returns (step, state_shardings) where
@@ -67,6 +67,12 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
     feed_specs overrides the default dp batch sharding per feed name
     (e.g. {"tokens": P("dp", "sp")} lays the sequence dim over the sp
     axis for sequence-parallel programs).
+
+    spec_overrides overrides the heuristic `param_spec` per STATE var
+    name — the spmd partition-plan hook (spmd/plan.py): a plan entry
+    carries the final layout (zero1 already applied by the analyzer),
+    so an overridden name bypasses both the heuristic and the zero1
+    rewrite here.
 
     With FLAGS_verify_sharding on, the static SPMD analyzer runs over
     the program/mesh pair first (unless the caller already did —
@@ -86,7 +92,11 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
     acc_names = optimizer_state_names(program) if program is not None \
         else None
 
+    spec_overrides = spec_overrides or {}
+
     def spec_for(name, shape):
+        if name in spec_overrides:
+            return spec_overrides[name]
         spec = param_spec(name, shape, mesh, mp_axis=mp_axis)
         if zero_stage >= 1 and is_optimizer_state(name, known=acc_names):
             spec = zero1_spec(spec, shape, mesh, dp_axis=dp_axis)
@@ -158,13 +168,7 @@ class ParallelTrainer:
         from ..fluid.executor import Executor, CPUPlace
         from ..core.scope import Scope
 
-        if _flags.get_flag("verify_sharding"):
-            verify_sharding(self.main_program, self.mesh,
-                            self.feed_names, self.fetch_names,
-                            feed_specs=self.feed_specs,
-                            zero_stage=self.zero_stage,
-                            dp_axis=self.dp_axis, mp_axis=self.mp_axis,
-                            origin="parallel_trainer")
+        self._verify()
 
         scope = scope or Scope()
         exe = executor or Executor(CPUPlace())
@@ -185,16 +189,35 @@ class ParallelTrainer:
         fp = FunctionalProgram(self.main_program, self.feed_names,
                                fetch_all)
         state = state_from_scope(fp, scope)
-        self._step_fn, self._shardings = make_parallel_step(
-            self.main_program, self.feed_names, fetch_all,
-            self.mesh, state, dp_axis=self.dp_axis, mp_axis=self.mp_axis,
-            fp=fp, zero_stage=self.zero_stage, feed_specs=self.feed_specs)
+        self._step_fn, self._shardings = self._make_step(fp, state,
+                                                         fetch_all)
         # place state on the mesh
         self.state = {
             n: jax.device_put(np.asarray(v), self._shardings[n])
             for n, v in state.items()
         }
         return self
+
+    def _verify(self):
+        """The pre-startup trust-boundary gate; `SpmdTrainer` replaces
+        it with the partition-plan build (which raises on the same
+        S0xx errors, rules included)."""
+        if _flags.get_flag("verify_sharding"):
+            verify_sharding(self.main_program, self.mesh,
+                            self.feed_names, self.fetch_names,
+                            feed_specs=self.feed_specs,
+                            zero_stage=self.zero_stage,
+                            dp_axis=self.dp_axis, mp_axis=self.mp_axis,
+                            origin="parallel_trainer")
+
+    def _make_step(self, fp, state, fetch_all):
+        """Build (step_fn, state_shardings) — the lowering hook
+        subclasses override (SpmdTrainer routes plan specs and the
+        overlapped-dp schedule through here)."""
+        return make_parallel_step(
+            self.main_program, self.feed_names, fetch_all,
+            self.mesh, state, dp_axis=self.dp_axis, mp_axis=self.mp_axis,
+            fp=fp, zero_stage=self.zero_stage, feed_specs=self.feed_specs)
 
     def step(self, feeds):
         rng = jax.random.fold_in(self._base_rng, self._step_count)

@@ -198,14 +198,30 @@ class TrainingSupervisor:
         return (time.time() - self._last_ckpt_time
                 >= self._saver.interval_secs)
 
+    def _latest_snapshot(self):
+        """Newest complete snapshot path, routed through the saver
+        when it speaks the sharded protocol (`latest`) — the dense
+        `latest_checkpoint` scan would miss per-host shard manifests."""
+        if hasattr(self._saver, "latest"):
+            return self._saver.latest()
+        return latest_checkpoint(self.ckpt_dir)
+
     def _restore_latest(self):
         """Load the newest valid snapshot + meta into the scope; resets
-        step/epoch/batch to the restored position."""
-        step = load_checkpoint(self.ckpt_dir, scope=self._scope)
+        step/epoch/batch to the restored position.
+
+        A saver with `restore_latest` (the sharded-snapshot protocol,
+        e.g. `spmd.SpmdCheckpointSaver`) owns the load: state goes
+        straight back onto the mesh shard-by-shard and the scope is
+        never densified."""
+        if hasattr(self._saver, "restore_latest"):
+            step = self._saver.restore_latest(scope=self._scope)
+        else:
+            step = load_checkpoint(self.ckpt_dir, scope=self._scope)
         if step is None:
             raise IOError("no checkpoint to restore under %r"
                           % self.ckpt_dir)
-        snap = latest_checkpoint(self.ckpt_dir)
+        snap = self._latest_snapshot()
         meta = {}
         meta_path = os.path.join(snap, SUPERVISOR_META) if snap else None
         if meta_path and os.path.exists(meta_path):
@@ -315,7 +331,7 @@ class TrainingSupervisor:
         success."""
         self._install_signals()
         try:
-            if self.resume and latest_checkpoint(self.ckpt_dir):
+            if self.resume and self._latest_snapshot():
                 self._restore_latest()
             else:
                 # baseline snapshot: the rollback target before the

@@ -258,6 +258,7 @@ def _join(args, plan):
 
 
 def cmd_fit(args):
+    from paddle_tpu.obs import perf as obs_perf
     from paddle_tpu.tune import fit as tune_fit
 
     plan = _load_plan(args)
@@ -266,10 +267,17 @@ def cmd_fit(args):
         print("[ptune] no ptune-tagged measurements in %s for this "
               "plan — run `ptune measure` first" % args.history)
         return 2
-    cal = tune_fit.fit_calibration(pairs, model=plan.get("model"))
+    # multichip comm measurements (spmd/bench.py legs) price the comm
+    # coefficient when the history has any from the training class
+    comm_pairs = tune_fit.join_comm_history(
+        obs_perf.load_history(args.history))
+    cal = tune_fit.fit_calibration(pairs, model=plan.get("model"),
+                                   comm_pairs=comm_pairs)
     if args.json:
         print(json.dumps({"calibration": cal.to_dict(),
-                          "pairs": len(pairs)}, sort_keys=True))
+                          "pairs": len(pairs),
+                          "comm_pairs": len(comm_pairs)},
+                         sort_keys=True))
     else:
         print(tune_fit.format_fit_report(cal, pairs))
     if args.calibration:

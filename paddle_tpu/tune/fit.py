@@ -18,13 +18,22 @@ prediction for a record is
 
 and the least-squares fit learns (a, b, bias) — the multiplicative
 gap between roofline floors and reality, and the real dispatch cost.
-The comm term keeps its analytic ring-cost price until multi-chip
-measurement legs exist (ROADMAP item 1); its coefficient stays 1.0
-and the calibration says so in its `note`.
 
-Records with a stale/fallback platform are never trained on — the
-round-5 incident class; `pperf history --prune-stale` removes them
-from the file, and this module skips them even when it hasn't run.
+The comm term: multichip bench legs (spmd/bench.py, leg
+`multichip:<mesh>`) stamp a `comm` blob pairing the plan's analytic
+ring floor (`pred_s`) with a measured grad-allreduce time
+(`measured_s`); `join_comm_history` collects those pairs and
+`fit_calibration(comm_pairs=...)` prices the comm coefficient from
+them.  Without multichip records the coefficient stays at its prior
+(1.0 analytic) and the calibration says so in its `note`.
+
+Records are partitioned by `obs.perf.platform_class` (platform +
+device count + mesh): a CPU-simulated 8-device run must never train
+the calibration alongside single-chip TPU records — the fit keeps
+only the newest record's class and notes what it dropped.  Records
+with a stale/fallback platform are never trained on — the round-5
+incident class; `pperf history --prune-stale` removes them from the
+file, and this module skips them even when it hasn't run.
 """
 
 import json
@@ -32,8 +41,8 @@ import math
 
 from .rank import Calibration
 
-__all__ = ["join_history", "fit_calibration", "format_fit_report",
-           "load_hbm_calibration", "LEG_PREFIX"]
+__all__ = ["join_history", "join_comm_history", "fit_calibration",
+           "format_fit_report", "load_hbm_calibration", "LEG_PREFIX"]
 
 LEG_PREFIX = "ptune:"
 
@@ -122,7 +131,39 @@ def join_history(plan, records):
             / max(ent["dp"], 1),
             "overhead_s": t["overhead_s"],
             "platform": r.get("platform"),
+            "platform_class": obs_perf.platform_class(r),
             "leg": leg,
+        })
+    return pairs
+
+
+def join_comm_history(records):
+    """Comm-measurement pairs from multichip history records.
+
+    A multichip bench record (spmd/bench.py) carries a `comm` blob:
+    `pred_s` (the partition plan's analytic ring floor for one step's
+    gradient traffic) and `measured_s` (the timed bucketed
+    ring-allreduce of the same gradients on the same mesh).  Returns
+    [{"leg", "measured_s", "pred_s", "wire_bytes", "platform_class"}]
+    — stale platforms skipped, non-positive predictions skipped (no
+    ratio to learn from)."""
+    from ..obs import perf as obs_perf
+
+    pairs = []
+    for r in records:
+        comm = r.get("comm") or {}
+        meas = comm.get("measured_s")
+        pred = comm.get("pred_s")
+        if not meas or not pred or float(pred) <= 0:
+            continue
+        if obs_perf.is_stale_platform(r.get("platform")):
+            continue
+        pairs.append({
+            "leg": r.get("leg"),
+            "measured_s": float(meas),
+            "pred_s": float(pred),
+            "wire_bytes": comm.get("wire_bytes"),
+            "platform_class": obs_perf.platform_class(r),
         })
     return pairs
 
@@ -137,6 +178,33 @@ def _median(vals):
     return (vals[n // 2 - 1] + vals[n // 2]) / 2.0
 
 
+def _fit_comm(prior, comm_pairs, cls):
+    """(comm coefficient, note) — the median measured/predicted ring
+    ratio over comm pairs from the training platform class, or the
+    prior's analytic price when there is nothing (usable) to learn
+    from."""
+    if comm_pairs:
+        cp = [p for p in comm_pairs
+              if cls is None or p.get("platform_class") == cls]
+        if cp:
+            ratio = _median([p["measured_s"] / p["pred_s"]
+                             for p in cp])
+            if ratio is not None and math.isfinite(ratio) \
+                    and ratio > 0:
+                return float(ratio), (
+                    "comm coef %.3g fitted from %d multichip "
+                    "measurement(s)%s"
+                    % (ratio, len(cp),
+                       (" on %s" % cls) if cls else ""))
+        else:
+            return prior.coef["comm"], (
+                "comm term kept analytic: no multichip measurements "
+                "in training class %s" % cls)
+    return prior.coef["comm"], (
+        "comm term uncalibrated: measurements are single-chip "
+        "proxies (per-device batch slice)")
+
+
 def _rel_error(pairs, a, b, bias):
     """Median |predicted - measured| / measured over the pairs."""
     errs = []
@@ -146,11 +214,15 @@ def _rel_error(pairs, a, b, bias):
     return _median(errs)
 
 
-def fit_calibration(pairs, model=None, prior=None):
+def fit_calibration(pairs, model=None, prior=None, comm_pairs=None):
     """Least-squares per-term correction from measured pairs.
 
     prior: the Calibration the `error_before` is charged against
         (identity when None — the uncalibrated model).
+    comm_pairs: `join_comm_history` output; when present (and from
+        the training platform class), the comm coefficient becomes
+        the median measured/predicted ring-time ratio instead of the
+        analytic prior.
 
     Degenerate data falls back gracefully: one measurement (or a
     singular/negative LS solution) fits a single scalar on
@@ -159,7 +231,28 @@ def fit_calibration(pairs, model=None, prior=None):
     import numpy as np
 
     prior = prior or Calibration.identity()
+    notes = []
+    cls = None
+    if pairs:
+        # train on ONE platform class: the newest record's.  Mixing a
+        # cpu-simulated 8-device sweep with single-chip TPU history
+        # would average two different physical machines into one line.
+        cls = pairs[-1].get("platform_class")
+        kept = [p for p in pairs
+                if p.get("platform_class") == cls]
+        if len(kept) != len(pairs):
+            notes.append("dropped %d record(s) from other platform "
+                         "classes (training on %s)"
+                         % (len(pairs) - len(kept), cls))
+        pairs = kept
+    comm_coef, comm_note = _fit_comm(prior, comm_pairs, cls)
+    notes.append(comm_note)
     if not pairs:
+        if comm_pairs:
+            return Calibration(
+                coef=dict(prior.coef, comm=comm_coef),
+                bias_s=prior.bias_s, n=prior.n, model=model,
+                note="; ".join(notes))
         return prior
     err_before = _rel_error(pairs, prior.coef["compute"],
                             prior.coef["overhead"], prior.bias_s)
@@ -194,12 +287,10 @@ def fit_calibration(pairs, model=None, prior=None):
                       prior.bias_s)
         err_after = err_before
     return Calibration(
-        coef={"compute": a, "comm": prior.coef["comm"],
-              "overhead": b},
+        coef={"compute": a, "comm": comm_coef, "overhead": b},
         bias_s=bias, n=n, model=model,
         error_before=err_before, error_after=err_after,
-        note="comm term uncalibrated: measurements are single-chip "
-             "proxies (per-device batch slice)")
+        note="; ".join(notes))
 
 
 def format_fit_report(calibration, pairs):
@@ -212,8 +303,8 @@ def format_fit_report(calibration, pairs):
     a = calibration.coef["compute"]
     b = calibration.coef["overhead"]
     bias = calibration.bias_s
-    lines.append("  coef: compute %.4g, overhead %.4g, comm %.4g "
-                 "(analytic), bias %.4g ms"
+    lines.append("  coef: compute %.4g, overhead %.4g, comm %.4g, "
+                 "bias %.4g ms"
                  % (a, b, calibration.coef["comm"], bias * 1e3))
     lines.append("  %-44s %12s %12s %8s"
                  % ("candidate", "pred ms", "measured ms", "err"))

@@ -43,8 +43,14 @@ def _model_fn(name):
             "resnet50": model_zoo.resnet50}[name]
 
 
-def builder(model, image_size=None, class_dim=None):
+def builder(model, image_size=None, class_dim=None,
+            with_startup=False):
     """batch -> (main_program, loss_name) for `model`.
+
+    with_startup=True returns (main, startup, loss_name) instead —
+    callers that actually RUN the program (spmd/bench.py, pshard
+    selftest) need the startup program to materialize parameters;
+    ranking-only callers keep the two-tuple contract.
 
     Mirrors bench.py's training program: concrete feed shapes
     (append_batch_size=False, so the sharding analyzer sees the real
@@ -77,6 +83,8 @@ def builder(model, image_size=None, class_dim=None):
             avg_loss = fluid.layers.mean(loss)
             fluid.optimizer.MomentumOptimizer(
                 learning_rate=0.01, momentum=0.9).minimize(avg_loss)
+        if with_startup:
+            return main, startup, avg_loss.name
         return main, avg_loss.name
 
     return build

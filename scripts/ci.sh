@@ -46,6 +46,19 @@ timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
 echo "[ci] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, top-K measured with config blobs, calibration error shrinks) ..."
 timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
 
+echo "[ci] pshard selftest (rule precedence, rules reshape the layout, plan save/load fingerprint-stable, plan-driven SPMD step on 8 devices, sharded checkpoint round-trip with zero densified vars) ..."
+timeout 300 python -m paddle_tpu.tools.shard_cli --selftest
+
+echo "[ci] pshard plan (zero-device layout build: the dp=4,mp=2 zero1 artifact must render and carry a comm floor) ..."
+_plan=$(mktemp)
+timeout 300 python -m paddle_tpu.tools.shard_cli plan --model lenet5 \
+    --mesh dp=4,mp=2 --batch 64 --zero-stage 1 --out "$_plan" \
+    | grep -q "comm:" || {
+        echo "[ci] pshard plan rendered no comm floor" >&2; exit 1; }
+timeout 300 python -m paddle_tpu.tools.shard_cli show --plan "$_plan" \
+    >/dev/null
+rm -f "$_plan"
+
 echo "[ci] proglint selftest (verifier corruptions + sharding analyzer: lenet5/golden clean on 4 dryrun meshes, seeded S-code corruptions) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
@@ -82,6 +95,33 @@ assert rec.get('perf') and rec['perf'].get('verdict'), 'BENCH record carries no 
 print('[ci] warm bench leg: %d pcache hits, verdict %s' % (cc['hits'], rec['perf']['verdict']))
 "
 rm -rf "$_pcc_dir" "$_hist"
+# the MULTICHIP legs: SPMD scaling over two mesh shapes; every record
+# must carry the platform_class stamp (so the gate never baselines
+# 8-device runs against single-chip history) and a comm blob `ptune
+# fit` can price the comm coefficient from
+_mhist=$(mktemp)
+BENCH_MULTICHIP="dp=8|dp=4,mp=2" BENCH_MODEL=lenet5 BENCH_ITERS=2 \
+    BENCH_WARMUP=1 BENCH_PEAK_TFLOPS=0.05 BENCH_HISTORY="$_mhist" \
+    timeout 600 python bench.py
+python - "$_mhist" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert len(recs) >= 2, "MULTICHIP suite wrote %d record(s)" % len(recs)
+meshes = set()
+for r in recs:
+    assert r.get("platform_class", "").count(":") == 2, r
+    assert r.get("n_devices") == 8 and r.get("mfu") is not None, r
+    comm = r.get("comm") or {}
+    assert comm.get("measured_s") and comm.get("pred_s"), r
+    meshes.add(tuple(sorted(r["mesh"].items())))
+assert len(meshes) >= 2, "scaling curve needs >= 2 mesh shapes"
+from paddle_tpu.tune import fit
+pairs = fit.join_comm_history(recs)
+assert len(pairs) >= 2, "ptune fit rejected the comm measurements"
+print("[ci] MULTICHIP legs: %d records, %d mesh shapes, %d comm "
+      "pairs for ptune fit" % (len(recs), len(meshes), len(pairs)))
+EOF
+rm -f "$_mhist"
 # the dryrun is DEFINED on virtual CPU devices; never claim the real
 # chip from CI — a wedged claim would starve the bench watcher
 timeout 900 python -c \

@@ -43,11 +43,29 @@ timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
 echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate + sharding analyzer over the 4 dryrun meshes) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
+echo "[smoke] pshard selftest (rule precedence, plan round-trip, plan-driven SPMD step, sharded ckpt) ..."
+timeout 300 python -m paddle_tpu.tools.shard_cli --selftest
+
+echo "[smoke] pshard plan (lenet5 on dp=4,mp=2 zero1 — the reviewable layout artifact) ..."
+_plan=$(mktemp)
+timeout 300 python -m paddle_tpu.tools.shard_cli plan --model lenet5 \
+    --mesh dp=4,mp=2 --batch 64 --zero-stage 1 --out "$_plan"
+rm -f "$_plan"
+
+echo "[smoke] MULTICHIP legs (SPMD scaling across 2 mesh shapes, comm measured vs ring floor) ..."
+BENCH_MULTICHIP="dp=8|dp=4,mp=2" BENCH_MODEL=lenet5 BENCH_ITERS=2 \
+    BENCH_WARMUP=1 BENCH_PEAK_TFLOPS=0.05 \
+    timeout 600 python bench.py
+
 echo "[smoke] dryrun_multichip(8) ..."
-# Simulate the driver env exactly: JAX_PLATFORMS points at the real TPU
-# and the function itself must bootstrap the virtual CPU mesh.  timeout
-# turns a bootstrap regression (hanging on the tunnel) into a loud fail.
-timeout 300 env JAX_PLATFORMS=axon XLA_FLAGS= python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+# The gate's copy of the driver dryrun, pinned to the virtual CPU mesh
+# this script already exports: the old `JAX_PLATFORMS=axon XLA_FLAGS=`
+# form cleared the device-count flag and then fought the session's TPU
+# tunnel for the real chip — exactly what the header forbids.  timeout
+# turns a bootstrap regression into a loud fail.
+timeout 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
 if [[ "${1:-}" == "--full" ]]; then
   echo "[smoke] full test suite ..."

@@ -46,6 +46,7 @@ setup(
             "pperf=paddle_tpu.tools.perf_cli:main",
             "pmem=paddle_tpu.tools.mem_cli:main",
             "ptune=paddle_tpu.tools.tune_cli:main",
+            "pshard=paddle_tpu.tools.shard_cli:main",
         ],
     },
 )
