@@ -109,17 +109,26 @@ class FleetReporter:
 
     `prefixes` bounds the pushed payload (DEFAULT_PUSH_PREFIXES keeps
     it a few KB per host — the pull path's list buffer is finite);
-    prefixes=None pushes the full registry."""
+    prefixes=None pushes the full registry.
+
+    `span_window` > 0 additionally publishes this process's recent
+    trace events (`obs.comm.span_window_payload`, bounded to that
+    many events) under `/obsspan/<host>` on every push, so
+    `pcomm merge` can stitch a fleet-wide comm timeline without any
+    extra worker-side daemon."""
 
     def __init__(self, master, host=None, interval_s=2.0,
-                 prefixes=DEFAULT_PUSH_PREFIXES, ttl_factor=3):
+                 prefixes=DEFAULT_PUSH_PREFIXES, ttl_factor=3,
+                 span_window=0):
         mhost, mport = str(master).rsplit(":", 1)
         self._master = (mhost, int(mport))
         self.host = host or host_id()
         self.interval_s = float(interval_s)
         self.prefixes = prefixes
+        self.span_window = int(span_window)
         self.ttl_ms = max(1000, int(self.interval_s * 1000 * ttl_factor))
         self._lease = None
+        self._span_lease = None
         self._stop = threading.Event()
         self._thread = None
         reg = registry_mod.get_registry()
@@ -167,6 +176,13 @@ class FleetReporter:
             return False
         self._lease = lease
         self._pushed.inc()
+        if self.span_window > 0:
+            from . import comm as comm_mod
+
+            self._span_lease = comm_mod.push_span_window(
+                "%s:%d" % self._master, host=self.host,
+                limit=self.span_window, ttl_ms=self.ttl_ms,
+                lease_prev=self._span_lease)
         return True
 
     def _loop(self):
@@ -186,18 +202,25 @@ class FleetReporter:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        if unregister and self._lease is not None:
+        leases = [l for l in (self._lease, self._span_lease)
+                  if l is not None]
+        if unregister and leases:
             from .. import native
 
             try:
                 client = native.MasterClient(*self._master)
                 try:
-                    client.unregister(self._lease)
+                    for lease in leases:
+                        try:
+                            client.unregister(lease)
+                        except (ConnectionError, OSError):
+                            pass
                 finally:
                     client.close()
             except (ConnectionError, OSError):
-                pass  # TTL reclaims it
-            self._lease = None
+                pass  # TTL reclaims them
+        self._lease = None
+        self._span_lease = None
 
 
 class FleetAggregator:
@@ -208,6 +231,7 @@ class FleetAggregator:
         self._hosts = {}
         self._collected = set()   # hosts sourced from the lease store
         self._published = set()   # hosts with live per-host gauges
+        self._age_published = set()  # hosts with a live age gauge
         self._lock = threading.Lock()
 
     # -- intake --------------------------------------------------------------
@@ -370,6 +394,23 @@ class FleetAggregator:
             for host in departed:
                 host_ms.remove(host=host)
                 straggler.remove(host=host)
+            # snapshot age covers EVERY host with a snapshot, not just
+            # the ones reporting step data — a host whose last push is
+            # aging toward its TTL is the earliest straggler signal
+            age_gauge = reg.gauge(
+                "fleet_snapshot_age_seconds",
+                "seconds since the host's last fleet snapshot push",
+                labelnames=("host",))
+            now = time.time()
+            snaps = self.snapshots()
+            for host, payload in snaps.items():
+                age_gauge.labels(host=host).set(
+                    round(max(0.0, now - payload.get("ts", now)), 3))
+            with self._lock:
+                age_departed = self._age_published - set(snaps)
+                self._age_published = set(snaps)
+            for host in age_departed:
+                age_gauge.remove(host=host)
             reg.gauge("fleet_hosts",
                       "hosts with a live fleet snapshot") \
                .set(len(self.hosts()))

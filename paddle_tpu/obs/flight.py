@@ -41,10 +41,39 @@ from . import trace as trace_mod
 
 __all__ = ["FlightRecorder", "install", "uninstall", "get_recorder",
            "active", "record_step", "on_crash", "suppressed",
-           "describe_feeds"]
+           "describe_feeds", "set_host_context", "clear_host_context",
+           "host_context"]
 
 BUNDLE_KIND = "paddle_tpu.flight"
 BUNDLE_VERSION = 1
+
+# which host/process this bundle came from: on a multi-host job the
+# bundles from every worker land in a shared bucket, and a post-mortem
+# that can't say "host3, process_index 3, dp=8 mesh, plan <fp>" is a
+# guessing game.  SpmdTrainer stamps this at verify time; standalone
+# runs may call set_host_context themselves.  Module-global (not
+# per-recorder) so install() cycles don't lose it.
+_host_context = {}
+
+
+def set_host_context(**kv):
+    """Merge identity fields (host, process_index, mesh_axes,
+    plan_fingerprint, ...) into every future bundle; None values
+    delete the key."""
+    for key, value in kv.items():
+        if value is None:
+            _host_context.pop(key, None)
+        else:
+            _host_context[key] = value
+    return dict(_host_context)
+
+
+def clear_host_context():
+    _host_context.clear()
+
+
+def host_context():
+    return dict(_host_context)
 
 
 def describe_feeds(feed):
@@ -171,6 +200,8 @@ class FlightRecorder:
             "registry": telemetry_mod.snapshot(),
             "recent_spans": self._recent_spans(),
         }
+        if _host_context:
+            doc["host_context"] = dict(_host_context)
         # the request this thread was serving when it crashed: dump()
         # runs on the crashing thread (excepthook / exception-path
         # hooks), so the thread-local binding IS the dying request —

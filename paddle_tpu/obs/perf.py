@@ -579,11 +579,19 @@ def normalize_record(record, leg=None, ts=None):
         norm["config"] = cfg
     comm = record.get("comm")
     if comm:
-        # multichip comm measurement (spmd/bench.py): the plan's
-        # analytic ring floor vs the timed grad-allreduce — the pair
-        # `ptune fit` prices the comm coefficient from
+        # multichip comm measurement (spmd/bench.py + obs/comm.py):
+        # the plan's analytic ring floor vs the timed grad-allreduce
+        # (the pair `ptune fit` prices the comm coefficient from),
+        # plus the overlap-efficiency split and the mode stamps that
+        # keep fallback (gspmd) runs out of the overlap baseline.
+        # The per-bucket detail stays OUT of history lines (one-screen
+        # greppable); pcomm's calibration blob carries it instead.
         norm["comm"] = {
-            k: comm[k] for k in ("wire_bytes", "pred_s", "measured_s")
+            k: comm[k] for k in
+            ("wire_bytes", "pred_s", "measured_s", "bucket_bytes",
+             "n_buckets", "comm_ratio", "exposed_s", "hidden_s",
+             "overlap_efficiency", "step_mode",
+             "overlap_fallback_reason", "plan_fingerprint")
             if comm.get(k) is not None}
     return norm
 
@@ -743,10 +751,24 @@ def _mem_peak(rec, key):
     return float(v) if v else None
 
 
+# comm-time keys the gate may compare, best first: the EXPOSED comm
+# time (step wall minus compute-only twin — what overlap actually
+# failed to hide; only real overlapped runs carry it, so fallback
+# records can never pollute that baseline) then the standalone timed
+# ring.  Same-key discipline as _MEM_KEYS: exposed-vs-standalone is
+# apples-to-oranges by construction.
+_COMM_KEYS = ("exposed_s", "measured_s")
+
+
+def _comm_val(rec, key):
+    v = (rec.get("comm") or {}).get(key)
+    return float(v) if v else None
+
+
 def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                  tolerance=DEFAULT_TOLERANCE, metric_tolerance=None,
                  step_tolerance=None, allow_stale=False, metrics=None,
-                 mem_tolerance=None):
+                 mem_tolerance=None, comm_tolerance=None):
     """Noise-aware regression gate over history records.
 
     Per metric: the NEWEST record is the candidate; the baseline is
@@ -773,6 +795,15 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
         doesn't yet cost step time still eats the headroom the next
         batch-size bump needs.  Records without memory blobs are
         never failed on memory.
+      * comm time (OPT-IN via `comm_tolerance`): candidate exposed
+        comm seconds (`_COMM_KEYS` off the record's "comm" blob —
+        exposed_s when the run was really overlapped, else the
+        standalone timed ring) above baseline * (1 + comm tol) fails
+        — an overlap regression that throughput noise still hides
+        fails CI the way a memory one does.  Only records carrying
+        the SAME comm key compare (fallback/gspmd runs never carry
+        `exposed_s`, so they cannot pollute the overlap baseline);
+        records without comm blobs are never failed on comm.
 
     `metrics`, when given, restricts gating to those metric names.
     """
@@ -882,6 +913,35 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                             % (key, cand_mem / 2**20,
                                base_mem / 2**20, rise * 100,
                                float(mem_tolerance) * 100)))
+                    failed = True
+                break
+        if not failed and comm_tolerance is not None:
+            # same-key discipline as the memory gate: exposed_s only
+            # exists on genuinely overlapped runs, so a fallback run
+            # (no exposed_s) compares on measured_s instead and can
+            # never drag the overlap baseline down
+            for key in _COMM_KEYS:
+                cand_comm = _comm_val(cand, key)
+                if cand_comm is None:
+                    continue
+                base_vals = [c for c in
+                             (_comm_val(r, key) for r in window)
+                             if c is not None]
+                if not base_vals:
+                    continue
+                base_comm = _median(base_vals)
+                if cand_comm > base_comm * (1.0 +
+                                            float(comm_tolerance)):
+                    rise = cand_comm / base_comm - 1.0
+                    result.failures.append(dict(
+                        base_info, kind="comm", value=cand_comm,
+                        baseline=round(base_comm, 6),
+                        n=len(base_vals),
+                        why="comm time (%s) %.3f ms vs baseline "
+                            "median %.3f ms (+%.1f%% > %.1f%% tol)"
+                            % (key, cand_comm * 1e3,
+                               base_comm * 1e3, rise * 100,
+                               float(comm_tolerance) * 100)))
                     failed = True
                 break
         if not failed:

@@ -112,12 +112,20 @@ def reset():
 class _TracingGuard:
     def __init__(self, max_events):
         self._max_events = max_events
+        self._prev_max = None
 
     def __enter__(self):
+        # scoped API: a guard-local bound must not leak into every
+        # later enable() of the process (which would silently drop
+        # their events once the small buffer fills)
+        self._prev_max = _max_events
         enable(max_events=self._max_events, clear=True)
         return self
 
     def __exit__(self, *exc):
+        global _max_events
+        with _lock:
+            _max_events = self._prev_max
         disable()
         return False
 

@@ -229,24 +229,40 @@ def bucketed_allreduce(grads, bucket_bytes, axis_name="dp",
     (tiny grads amortize) or one for everything (no overlap).  `order`
     (default: reversed dict order) fixes which grads reduce first.
     """
+    # runs at TRACE time under jit: the obs.comm hook records the
+    # bucket schedule + nested spans once per trace, and the compiled
+    # program replays the schedule invisibly (runtime per-bucket truth
+    # comes from obs.comm.measure_bucket_times)
+    from ..obs import comm as obs_comm
+
     if not grads:
         return grads
     names = list(order) if order is not None \
         else list(reversed(list(grads)))
     sized = [(n, grads[n].size * grads[n].dtype.itemsize)
              for n in names]
+    size_of = dict(sized)
+    buckets = grad_buckets(sized, bucket_bytes)
+    sched = obs_comm.record_schedule(
+        "allreduce", axis_name,
+        [{"bucket": i, "names": list(b),
+          "bytes": int(sum(size_of[n] for n in b))}
+         for i, b in enumerate(buckets)], mean=mean)
     out = dict(grads)
-    for bucket in grad_buckets(sized, bucket_bytes):
-        parts = [grads[n].astype(jnp.float32).reshape(-1)
-                 for n in bucket]
-        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        flat = ring_allreduce(flat, axis_name, mean=mean)
-        off = 0
-        for n in bucket:
-            size = grads[n].size
-            out[n] = flat[off:off + size].reshape(
-                grads[n].shape).astype(grads[n].dtype)
-            off += size
+    with obs_comm.schedule_span(sched):
+        for i, bucket in enumerate(buckets):
+            with obs_comm.bucket_span(sched, i):
+                parts = [grads[n].astype(jnp.float32).reshape(-1)
+                         for n in bucket]
+                flat = parts[0] if len(parts) == 1 \
+                    else jnp.concatenate(parts)
+                flat = ring_allreduce(flat, axis_name, mean=mean)
+                off = 0
+                for n in bucket:
+                    size = grads[n].size
+                    out[n] = flat[off:off + size].reshape(
+                        grads[n].shape).astype(grads[n].dtype)
+                    off += size
     return out
 
 

@@ -62,13 +62,17 @@ def measure_comm(trainer, reps=5, bucket_bytes=None):
     trainable parameter (gradient volume == parameter volume for the
     image models) inside a jitted shard_map on the trainer's mesh,
     and pairs the median wall time with the plan's analytic
-    `step_seconds_floor`.  Returns the `comm` blob for the history
-    record, or None when the plan has no wire traffic to measure
-    (dp=1 or a fully replicated layout).
+    `step_seconds_floor`.  The blob also carries the PER-BUCKET split
+    (`obs.comm.measure_bucket_times` — each bucket's ring chain timed
+    on its own against its own ring floor) and `comm_ratio`, the
+    median per-bucket measured/predicted drift `ptune fit` and the
+    `pcomm` drift blob both price.  Returns None when the plan has no
+    wire traffic to measure (dp=1 or a fully replicated layout).
     """
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from ..obs import comm as obs_comm
     from ..parallel import sharding as psharding
     from ..parallel.ring import bucketed_allreduce
     from .overlap import DEFAULT_BUCKET_BYTES
@@ -108,12 +112,23 @@ def measure_comm(trainer, reps=5, bucket_bytes=None):
             t0 = time.perf_counter()
             jax.block_until_ready(fn(grads))
             times.append(time.perf_counter() - t0)
-    return {
+    blob = {
         "wire_bytes": int(wire_bytes),
         "pred_s": float(pred_s),
         "measured_s": float(np.median(times)),
         "bucket_bytes": int(bucket_bytes),
     }
+    buckets = obs_comm.measure_bucket_times(
+        trainer.mesh, grads, bucket_bytes, axis_name=dp_axis,
+        reps=min(int(reps), 3))
+    if buckets:
+        blob["n_buckets"] = len(buckets["buckets"])
+        blob["buckets"] = buckets["buckets"]
+        ratios = [r["ratio"] for r in buckets["buckets"]
+                  if r.get("ratio")]
+        if ratios:
+            blob["comm_ratio"] = round(float(np.median(ratios)), 6)
+    return blob
 
 
 def run_leg(model="lenet5", mesh_spec="dp=8", batch=None, iters=8,
@@ -181,6 +196,29 @@ def run_leg(model="lenet5", mesh_spec="dp=8", batch=None, iters=8,
                     / (peak_tflops * n_devices * 1e3), 4)
 
     comm = measure_comm(trainer)
+    if comm is not None:
+        # stamp HOW this leg reduced gradients: fallback (gspmd) runs
+        # carry their reason and never acquire overlap-efficiency
+        # fields, so they are distinguishable in perf_history and the
+        # `pperf gate --comm-tolerance` exposed-comm baseline only
+        # ever joins real overlapped runs against each other
+        comm["step_mode"] = trainer.step_mode
+        comm["plan_fingerprint"] = trainer.plan.fingerprint()
+        if trainer.overlap_fallback_reason:
+            comm["overlap_fallback_reason"] = \
+                trainer.overlap_fallback_reason
+        if trainer.step_mode == "overlap-dp" and \
+                os.environ.get("BENCH_OVERLAP_REPORT", "1") != "0":
+            from ..obs import comm as obs_comm
+
+            rep = obs_comm.overlap_report(trainer, feed_pool[0],
+                                          reps=min(iters, 3))
+            if rep.get("supported"):
+                comm["exposed_s"] = round(rep["exposed_s"], 6)
+                comm["hidden_s"] = round(rep["hidden_s"], 6)
+                if rep.get("overlap_efficiency") is not None:
+                    comm["overlap_efficiency"] = round(
+                        rep["overlap_efficiency"], 4)
     record = {
         "metric": "multichip_%s_%s" % (model, _mesh_tag(mesh_spec)),
         "value": round(samples_per_sec, 2),

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..fluid.executor import ExecContext, apply_op, RNG_STATE_NAME
 from ..jit import FunctionalProgram
+from ..obs import trace as obs_trace
 from ..parallel import sharding as psharding
 from ..parallel.ring import bucketed_allreduce
 
@@ -97,7 +98,8 @@ def overlap_supported(program, mesh, dp_axis="dp", zero_stage=0):
 def make_overlapped_dp_step(program, feed_names, fetch_names, mesh,
                             state_template, dp_axis="dp",
                             bucket_bytes=DEFAULT_BUCKET_BYTES,
-                            donate_state=True, feed_specs=None):
+                            donate_state=True, feed_specs=None,
+                            skip_reduce=False):
     """Compile the program into the overlapped explicit-dp step.
 
     Returns (step, state_shardings) with the `make_parallel_step`
@@ -105,6 +107,14 @@ def make_overlapped_dp_step(program, feed_names, fetch_names, mesh,
     replicated (pure dp), feeds sharded on their batch dim, scalar
     fetches returned as the cross-shard mean (== the global-batch
     value).  Callers gate on `overlap_supported` first.
+
+    skip_reduce=True elides the bucketed ring entirely — the
+    optimizer applies LOCAL gradients, so the result is numerically
+    WRONG across shards.  It exists for one purpose: the compute-only
+    twin `obs.comm.overlap_report` times against the real step, so
+    `step_wall - compute_only_wall` isolates the EXPOSED comm time
+    (pair it with donate_state=False to keep the measured trainer's
+    state buffers alive).
     """
     ok, reason = overlap_supported(program, mesh, dp_axis=dp_axis)
     if not ok:
@@ -122,10 +132,15 @@ def make_overlapped_dp_step(program, feed_names, fetch_names, mesh,
         for i, od in enumerate(ops):
             if i == split:
                 grads = {g: env[g] for g in grad_order if g in env}
-                env.update(bucketed_allreduce(
-                    grads, bucket_bytes, axis_name=dp_axis,
-                    mean=True, order=[g for g in reduce_order
-                                      if g in grads]))
+                obs_trace.instant("comm/reduce_seam", cat="comm",
+                                  n_grads=len(grads),
+                                  bucket_bytes=int(bucket_bytes),
+                                  skip_reduce=bool(skip_reduce))
+                if not skip_reduce:
+                    env.update(bucketed_allreduce(
+                        grads, bucket_bytes, axis_name=dp_axis,
+                        mean=True, order=[g for g in reduce_order
+                                          if g in grads]))
             apply_op(ctx, od)
         new_state = dict(state)
         for n in fp.state_out_names:

@@ -103,6 +103,18 @@ class SpmdTrainer(ParallelTrainer):
                     "partition plan was built for mesh %r but the "
                     "trainer mesh is %r — rebuild with `pshard plan`"
                     % (dict(self.plan.mesh_axes), want))
+        # stamp this worker's identity into any future flight bundle:
+        # a multi-host post-mortem must say WHICH process on WHICH
+        # mesh (and against which plan) died, not just that one did
+        from ..obs import fleet as obs_fleet
+        from ..obs import flight as obs_flight
+
+        obs_flight.set_host_context(
+            host=obs_fleet.host_id(),
+            process_index=int(jax.process_index()),
+            mesh_axes={a: int(s)
+                       for a, s in dict(self.mesh.shape).items()},
+            plan_fingerprint=self.plan.fingerprint())
 
     def _make_step(self, fp, state, fetch_all, donate_state=True):
         if self.plan is None:       # init() not used (tests drive

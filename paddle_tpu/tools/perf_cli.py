@@ -89,6 +89,13 @@ def parse_args(argv=None):
                         "(bench.py stamps them; obs/mem.py) — an HBM "
                         "regression fails CI like a step-time one; "
                         "omitted = memory is not gated")
+    p.add_argument("--comm-tolerance", type=float, default=None,
+                   help="gate: OPT-IN relative comm-time tolerance "
+                        "over the records' \"comm\" blobs (exposed_s "
+                        "for overlapped runs, else measured_s; "
+                        "obs/comm.py) — an overlap regression fails "
+                        "CI even while throughput noise hides it; "
+                        "omitted = comm is not gated")
     p.add_argument("--allow-stale", action="store_true",
                    help="gate: downgrade stale-platform hard fails "
                         "to skips")
@@ -239,7 +246,8 @@ def cmd_gate(args):
         step_tolerance=args.step_tolerance,
         allow_stale=args.allow_stale,
         metrics=set(args.metric) if args.metric else None,
-        mem_tolerance=args.mem_tolerance)
+        mem_tolerance=args.mem_tolerance,
+        comm_tolerance=args.comm_tolerance)
     if args.json:
         print(json.dumps(result.to_dict(), sort_keys=True))
     else:

@@ -111,6 +111,12 @@ def parse_args(argv=None):
                         "its measured actual/static ratio scales the "
                         "static HBM peak before the S005 budget check "
                         "(tune.fit.load_hbm_calibration)")
+    p.add_argument("--comm-calibration", default=None,
+                   help="fit: a `pcomm report --calibration-out` "
+                        "blob; its measured/predicted ring pairs "
+                        "price the comm coefficient alongside any "
+                        "multichip history records "
+                        "(tune.fit.load_comm_calibration)")
     p.add_argument("--out", default=None,
                    help="plan: write the launch plan JSON here")
     p.add_argument("--topk", type=int, default=None,
@@ -271,6 +277,9 @@ def cmd_fit(args):
     # coefficient when the history has any from the training class
     comm_pairs = tune_fit.join_comm_history(
         obs_perf.load_history(args.history))
+    if getattr(args, "comm_calibration", None):
+        comm_pairs = comm_pairs + tune_fit.load_comm_calibration(
+            args.comm_calibration)
     cal = tune_fit.fit_calibration(pairs, model=plan.get("model"),
                                    comm_pairs=comm_pairs)
     if args.json:

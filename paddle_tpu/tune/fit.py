@@ -42,7 +42,8 @@ import math
 from .rank import Calibration
 
 __all__ = ["join_history", "join_comm_history", "fit_calibration",
-           "format_fit_report", "load_hbm_calibration", "LEG_PREFIX"]
+           "format_fit_report", "load_hbm_calibration",
+           "load_comm_calibration", "LEG_PREFIX"]
 
 LEG_PREFIX = "ptune:"
 
@@ -70,6 +71,45 @@ def load_hbm_calibration(path):
         raise ValueError("memory calibration %s carries unusable "
                          "hbm_ratio=%r" % (path, blob.get("hbm_ratio")))
     return ratio
+
+
+def load_comm_calibration(path):
+    """Load a `pcomm report --calibration-out` blob
+    (obs/comm.calibration_blob) and return its measured/predicted
+    ring pairs in the `join_comm_history` shape, ready for
+    `fit_calibration(comm_pairs=...)` — each pair keeps its
+    `platform_class` stamp so the fit's same-class filter still
+    excludes cpu-simulated rings from a TPU calibration.  Raises on a
+    blob of the wrong kind or one with no usable pairs (a corrupt
+    calibration must never silently keep the analytic prior while
+    claiming to have fitted)."""
+    from ..obs.comm import COMM_CALIBRATION_KIND
+
+    with open(path) as f:
+        blob = json.load(f)
+    if blob.get("kind") != COMM_CALIBRATION_KIND:
+        raise ValueError(
+            "%s is not a pcomm comm calibration (kind=%r; produce "
+            "one with `pcomm report --calibration-out`)"
+            % (path, blob.get("kind")))
+    pairs = []
+    for p in blob.get("pairs") or []:
+        try:
+            measured = float(p["measured_s"])
+            pred = float(p["pred_s"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if not (math.isfinite(measured) and math.isfinite(pred)) \
+                or measured <= 0 or pred <= 0:
+            continue
+        pairs.append({"leg": p.get("leg", "pcomm"),
+                      "measured_s": measured, "pred_s": pred,
+                      "wire_bytes": int(p.get("wire_bytes") or 0),
+                      "platform_class": p.get("platform_class")})
+    if not pairs:
+        raise ValueError("comm calibration %s carries no usable "
+                         "measured/predicted pairs" % path)
+    return pairs
 
 
 def _plan_entries(plan):

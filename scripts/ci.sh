@@ -43,6 +43,9 @@ timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 echo "[ci] pmem selftest (static timeline + counter track, static-vs-XLA drift join on lenet5 with calibration blob, donation audit finds a forked Adam slot, forced-tiny-budget OOM flight bundle blames the peak buffer) ..."
 timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
 
+echo "[ci] pcomm selftest (per-bucket comm spans in reduce order, overlap exposed-vs-hidden split, cross-host span merge with recovered clock skew, drift blob -> ptune comm coef, comm gate discriminates) ..."
+timeout 300 python -m paddle_tpu.tools.comm_cli --selftest
+
 echo "[ci] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, top-K measured with config blobs, calibration error shrinks) ..."
 timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
 

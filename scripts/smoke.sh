@@ -37,6 +37,9 @@ timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 echo "[smoke] pmem selftest (memory timeline, drift join + calibration, donation audit, OOM flight bundle) ..."
 timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
 
+echo "[smoke] pcomm selftest (comm spans, overlap split, cross-host merge, comm gate) ..."
+timeout 300 python -m paddle_tpu.tools.comm_cli --selftest
+
 echo "[smoke] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, measured top-K + calibration) ..."
 timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
 

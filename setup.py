@@ -47,6 +47,7 @@ setup(
             "pmem=paddle_tpu.tools.mem_cli:main",
             "ptune=paddle_tpu.tools.tune_cli:main",
             "pshard=paddle_tpu.tools.shard_cli:main",
+            "pcomm=paddle_tpu.tools.comm_cli:main",
         ],
     },
 )

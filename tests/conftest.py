@@ -36,6 +36,7 @@ def fresh_obs():
     tracer, flight recorder, health switch): reset it around every
     test so counters don't bleed across tests and order-dependent
     assertions can't flake."""
+    from paddle_tpu.obs import comm as obs_comm
     from paddle_tpu.obs import flight as obs_flight
     from paddle_tpu.obs import health as obs_health
     from paddle_tpu.obs import mem as obs_mem
@@ -48,13 +49,16 @@ def fresh_obs():
 
     obs_registry.reset_registry()
     obs_mem.reset()
+    obs_comm.reset()
     obs_trace.disable()
     obs_trace.reset()
     r_faults.disable()
     yield
     obs_mem.reset()
+    obs_comm.reset()
     obs_health.disable()
     obs_flight.uninstall()
+    obs_flight.clear_host_context()
     obs_perf.uninstall()
     obs_tail.uninstall()
     obs_tele.install_step_observer(None)

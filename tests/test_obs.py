@@ -112,6 +112,21 @@ def test_tracer_buffer_bound_counts_drops():
         doc = obs_trace.to_chrome_trace()
     assert doc["otherData"]["dropped_events"] > 0
     assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) <= 10
+    # the guard-scoped bound must NOT leak: a later enable() (no
+    # explicit bound) gets the previous cap back, not the tiny one —
+    # otherwise every trace in the process silently drops events
+    # after the tenth
+    obs_trace.enable()
+    try:
+        for i in range(50):
+            with obs_trace.span("t%d" % i):
+                pass
+        assert obs_trace.dropped_events() == 0
+        assert len([e for e in obs_trace.events()
+                    if e["ph"] == "X"]) == 50
+    finally:
+        obs_trace.disable()
+        obs_trace.reset()
 
 
 def test_chrome_trace_schema_and_file_round_trip(tmp_path):
