@@ -288,6 +288,18 @@ def main():
         from paddle_tpu.spmd import bench as spmd_bench
 
         raise SystemExit(spmd_bench.main_from_env())
+    if os.environ.get("BENCH_SERVING"):
+        # SERVING leg: open-loop load against a loopback server; the
+        # record's `latency` blob (p50..p99.9 + SLO attainment) is
+        # what `pperf gate --latency-tolerance` regresses on.  Plain
+        # return, not SystemExit: mega_bench's run_one re-raises
+        # SystemExit as a leg failure.
+        from paddle_tpu.obs import load as obs_load
+
+        record = obs_load.run_serving_bench()
+        print(json.dumps(record))
+        _append_history(record)
+        return
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model not in _MODELS:
         raise SystemExit("BENCH_MODEL must be one of %s"

@@ -593,6 +593,20 @@ def normalize_record(record, leg=None, ts=None):
              "overlap_efficiency", "step_mode",
              "overlap_fallback_reason", "plan_fingerprint")
             if comm.get(k) is not None}
+    latency = record.get("latency")
+    if latency:
+        # serving-tail measurement (obs/load.py latency_blob): the
+        # open/closed-loop percentiles + SLO attainment the pload
+        # harness distilled from a run — `pperf gate
+        # --latency-tolerance` regresses on the percentile keys.
+        # Raw per-request samples and the worst-K joins stay OUT of
+        # history lines; the pload --report file carries those.
+        norm["latency"] = {
+            k: latency[k] for k in
+            ("mode", "n", "p50_ms", "p90_ms", "p99_ms", "p99_9_ms",
+             "offered_rps", "achieved_rps", "slo_ms",
+             "slo_attainment")
+            if latency.get(k) is not None}
     return norm
 
 
@@ -765,10 +779,32 @@ def _comm_val(rec, key):
     return float(v) if v else None
 
 
+# tail-latency keys the gate may compare, best (deepest tail) first:
+# p99.9 when the run was large enough to resolve it, else p99, p90,
+# p50.  Same-key discipline as _MEM_KEYS/_COMM_KEYS — a short run's
+# p50 must never gate against a long run's p99.9 baseline.  Records
+# additionally only compare within the same generator mode (open vs
+# closed loop): closed-loop percentiles are coordinated-omission-
+# blind by construction, so an open-loop candidate against a
+# closed-loop baseline would fail on the measurement discipline, not
+# the server.
+_LATENCY_KEYS = ("p99_9_ms", "p99_ms", "p90_ms", "p50_ms")
+
+
+def _latency_val(rec, key):
+    v = (rec.get("latency") or {}).get(key)
+    return float(v) if v else None
+
+
+def _latency_mode(rec):
+    return (rec.get("latency") or {}).get("mode")
+
+
 def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                  tolerance=DEFAULT_TOLERANCE, metric_tolerance=None,
                  step_tolerance=None, allow_stale=False, metrics=None,
-                 mem_tolerance=None, comm_tolerance=None):
+                 mem_tolerance=None, comm_tolerance=None,
+                 latency_tolerance=None):
     """Noise-aware regression gate over history records.
 
     Per metric: the NEWEST record is the candidate; the baseline is
@@ -804,6 +840,16 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
         the SAME comm key compare (fallback/gspmd runs never carry
         `exposed_s`, so they cannot pollute the overlap baseline);
         records without comm blobs are never failed on comm.
+      * tail latency (OPT-IN via `latency_tolerance`): candidate
+        serving tail percentile (`_LATENCY_KEYS` off the record's
+        "latency" blob — p99.9 when resolved, else p99/p90/p50) above
+        baseline * (1 + latency tol) fails, naming the percentile —
+        a p99 regression that the mean-throughput check can't see is
+        exactly the capacity signal (obs/load.py).  Same-key AND
+        same-generator-mode discipline: open-loop and closed-loop
+        percentiles measure different things (coordinated omission)
+        and never compare; records without latency blobs are never
+        failed on latency.
 
     `metrics`, when given, restricts gating to those metric names.
     """
@@ -942,6 +988,40 @@ def gate_history(records, baseline_n=DEFAULT_BASELINE_N,
                             % (key, cand_comm * 1e3,
                                base_comm * 1e3, rise * 100,
                                float(comm_tolerance) * 100)))
+                    failed = True
+                break
+        if not failed and latency_tolerance is not None:
+            # same-key discipline again, plus generator-mode
+            # separation: an open-loop candidate only baselines
+            # against open-loop history (closed-loop percentiles are
+            # omission-blind and systematically lower)
+            cand_mode = _latency_mode(cand)
+            mode_window = [r for r in window
+                           if _latency_mode(r) == cand_mode]
+            for key in _LATENCY_KEYS:
+                cand_lat = _latency_val(cand, key)
+                if cand_lat is None:
+                    continue
+                base_vals = [v for v in
+                             (_latency_val(r, key)
+                              for r in mode_window)
+                             if v is not None]
+                if not base_vals:
+                    continue
+                base_lat = _median(base_vals)
+                if cand_lat > base_lat * (1.0 +
+                                          float(latency_tolerance)):
+                    rise = cand_lat / base_lat - 1.0
+                    result.failures.append(dict(
+                        base_info, kind="latency", value=cand_lat,
+                        baseline=round(base_lat, 3),
+                        n=len(base_vals),
+                        why="tail latency (%s, %s loop) %.3f ms vs "
+                            "baseline median %.3f ms (+%.1f%% > "
+                            "%.1f%% tol)"
+                            % (key, cand_mode, cand_lat, base_lat,
+                               rise * 100,
+                               float(latency_tolerance) * 100)))
                     failed = True
                 break
         if not failed:

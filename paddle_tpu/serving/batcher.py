@@ -132,12 +132,16 @@ class MicroBatcher:
         except queue.Full:
             if self.metrics:
                 self.metrics.rejected_queue_full.inc()
+                # the shed moment IS peak saturation — publish it, or
+                # a scrape between enqueue/dequeue samples reports a
+                # shedding server with a stale, shallow queue_depth
+                self.metrics.note_queue_depth(self._queue.qsize())
             raise QueueFullError(
                 "admission queue full (%d waiting)"
                 % self.config.queue_size)
         if self.metrics:
             self.metrics.requests_total.inc()
-            self.metrics.queue_depth.set(self._queue.qsize())
+            self.metrics.note_queue_depth(self._queue.qsize())
         return req.future
 
     def submit_and_wait(self, feeds, timeout_ms=None, ctx=None):
@@ -193,7 +197,7 @@ class MicroBatcher:
         except queue.Empty:
             return None
         if self.metrics:
-            self.metrics.queue_depth.set(self._queue.qsize())
+            self.metrics.note_queue_depth(self._queue.qsize())
         return item
 
     def _assemble(self, first):

@@ -51,6 +51,12 @@ class ServingMetrics:
         self.queue_depth = reg.gauge(
             "serving_queue_depth", "requests waiting in the admission "
             "queue")
+        # a scrape between enqueue/dequeue samples misses transient
+        # saturation; the high-watermark gauge keeps the worst depth
+        # seen since the last /metrics render (reset on scrape)
+        self.queue_depth_peak = reg.gauge(
+            "serving_queue_depth_peak",
+            "max admission-queue depth since the last scrape")
         self.inflight = reg.gauge(
             "serving_inflight_batches", "batches currently executing")
         self.batch_occupancy = reg.histogram(
@@ -77,6 +83,19 @@ class ServingMetrics:
         # (tests build many instances per process; last one wins, each
         # keeps its own `registry` intact either way)
         get_registry().attach("serving", reg)
+        import threading
+
+        self._depth_lock = threading.Lock()
+
+    def note_queue_depth(self, depth):
+        """Publish the live queue depth AND raise the high-watermark.
+        Called from every depth transition (enqueue, dequeue, and the
+        shed path) so the peak covers saturation a scrape would miss."""
+        depth = int(depth)
+        with self._depth_lock:
+            self.queue_depth.set(depth)
+            if depth > self.queue_depth_peak.value:
+                self.queue_depth_peak.set(depth)
 
     def observe_stage(self, stage, seconds, exemplar=None):
         """Record a per-stage latency in both systems: the histogram
@@ -95,9 +114,15 @@ class ServingMetrics:
         mount, so a scrape of an older server stays self-consistent).
         `exemplars=True` is for OpenMetrics-negotiated scrapes only
         (registry.render_text)."""
-        return get_registry().render_text(
+        text = get_registry().render_text(
             override_groups={"serving": self.registry},
             exemplars=exemplars)
+        # the peak gauge is a between-scrapes high-watermark: once a
+        # scrape has carried it out, restart the window at the live
+        # depth so the next scrape reports THAT interval's worst
+        with self._depth_lock:
+            self.queue_depth_peak.set(self.queue_depth.value)
+        return text
 
 
 class SLOTracker:

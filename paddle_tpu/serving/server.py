@@ -58,7 +58,7 @@ class ServerConfig:
                  max_wait_ms=5.0, queue_size=64, default_timeout_ms=None,
                  warmup=True, slo_ms=None, slo_target=0.99,
                  model_name="default", tail_slow_ms=None,
-                 tail_capacity=64, access_log=None):
+                 tail_capacity=64, access_log=None, retry_after_s=1.0):
         self.host = host
         self.port = int(port)
         self.max_batch = int(max_batch)
@@ -73,6 +73,10 @@ class ServerConfig:
                              else float(tail_slow_ms))
         self.tail_capacity = int(tail_capacity)
         self.access_log = access_log
+        # the backoff hint a 429 load-shed reply advertises in its
+        # Retry-After header (docs/SERVING.md backpressure contract);
+        # integer seconds on the wire, floor 1
+        self.retry_after_s = float(retry_after_s)
 
 
 def _to_list(arr):
@@ -156,7 +160,20 @@ class _Handler(BaseHTTPRequestHandler):
                         headers=echo)
             return
         status, body = owner.handle_infer(payload, ctx=ctx)
+        if status == 429:
+            # explicit backoff hint for closed-loop clients: shed work
+            # should not be instantly re-offered to a full queue
+            echo["Retry-After"] = "%d" % max(
+                1, int(round(owner.config.retry_after_s)))
         self._reply(status, body, headers=echo)
+
+
+class _ThreadingHTTPServer(ThreadingHTTPServer):
+    # the stdlib default accept backlog (5) RSTs connection bursts a
+    # load generator — or a real client fleet reconnecting after a
+    # blip — routinely produces; admission control belongs to the
+    # batcher queue (429), never to silent kernel-level resets
+    request_queue_size = 128
 
 
 class InferenceServer:
@@ -198,7 +215,7 @@ class InferenceServer:
         if self.config.warmup:
             self.engine.warmup()
         self.batcher.start()
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _ThreadingHTTPServer(
             (self.config.host, self.config.port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self

@@ -96,6 +96,13 @@ def parse_args(argv=None):
                         "obs/comm.py) — an overlap regression fails "
                         "CI even while throughput noise hides it; "
                         "omitted = comm is not gated")
+    p.add_argument("--latency-tolerance", type=float, default=None,
+                   help="gate: OPT-IN relative tail-latency tolerance "
+                        "over the records' \"latency\" blobs (pload "
+                        "runs; best percentile present, p99.9 first; "
+                        "obs/load.py) — a serving p99 regression "
+                        "fails CI even while throughput holds; "
+                        "omitted = latency is not gated")
     p.add_argument("--allow-stale", action="store_true",
                    help="gate: downgrade stale-platform hard fails "
                         "to skips")
@@ -247,7 +254,8 @@ def cmd_gate(args):
         allow_stale=args.allow_stale,
         metrics=set(args.metric) if args.metric else None,
         mem_tolerance=args.mem_tolerance,
-        comm_tolerance=args.comm_tolerance)
+        comm_tolerance=args.comm_tolerance,
+        latency_tolerance=args.latency_tolerance)
     if args.json:
         print(json.dumps(result.to_dict(), sort_keys=True))
     else:

@@ -40,6 +40,9 @@ timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 echo "[ci] pperf selftest (gate discriminates 20% regression + tpu-stale, step profiler ring/exports, loopback SLO burn, warm pcache blob) ..."
 timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 
+echo "[ci] pload selftest (open-loop p99 surfaces an injected stall closed-loop hides, worst request joins its /debug/tail span tree, access-log replay reproduces count + bucket mix, latency blob -> pperf gate --latency-tolerance verdict) ..."
+timeout 300 python -m paddle_tpu.tools.load_cli --selftest
+
 echo "[ci] pmem selftest (static timeline + counter track, static-vs-XLA drift join on lenet5 with calibration blob, donation audit finds a forked Adam slot, forced-tiny-budget OOM flight bundle blames the peak buffer) ..."
 timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
 

@@ -108,6 +108,10 @@ CONFIGS = [
                          "BENCH_MODE": "infer"}),
     ("infer-alexnet", {"BENCH_MODEL": "alexnet",
                        "BENCH_MODE": "infer"}),
+    # --- serving tail latency (obs/load.py): open-loop Poisson load
+    # against a loopback server; the record's `latency` blob is what
+    # `pperf gate --latency-tolerance` regresses on ---
+    ("serving-slo", {"BENCH_SERVING": "1"}),
     # last: its ~1500-op inception graph is the one compile that has
     # hung the remote compile service (sweep 1: >40 min, killed) — a
     # hang here can only cost this leg, not the suite
@@ -118,6 +122,7 @@ _MANAGED = ("BENCH_TAG", "BENCH_MODEL", "BENCH_MODE", "BENCH_BATCH",
             "BENCH_HIDDEN", "BENCH_RECOMPUTE", "BENCH_LAYOUT",
             "BENCH_AMP", "BENCH_LEG", "BENCH_MESH",
             "BENCH_MICRO_BATCH", "BENCH_PREFETCH", "BENCH_MEMORY",
+            "BENCH_SERVING",
             "FLAGS_amp_bf16_act", "FLAGS_fuse_optimizer",
             "FLAGS_bn_shifted_stats", "FLAGS_compile_passes")
 

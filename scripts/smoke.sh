@@ -34,6 +34,9 @@ timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 echo "[smoke] pperf selftest (regression gate, step profiler, SLO burn, warm pcache blob) ..."
 timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 
+echo "[smoke] pload selftest (open vs closed loop omission gap, tail join, replay fidelity, latency gate) ..."
+timeout 300 python -m paddle_tpu.tools.load_cli --selftest
+
 echo "[smoke] pmem selftest (memory timeline, drift join + calibration, donation audit, OOM flight bundle) ..."
 timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
 

@@ -48,6 +48,7 @@ setup(
             "ptune=paddle_tpu.tools.tune_cli:main",
             "pshard=paddle_tpu.tools.shard_cli:main",
             "pcomm=paddle_tpu.tools.comm_cli:main",
+            "pload=paddle_tpu.tools.load_cli:main",
         ],
     },
 )

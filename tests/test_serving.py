@@ -518,3 +518,140 @@ def test_server_bad_request_and_draining(tmp_path):
     status, body = server.handle_infer(
         {"inputs": {"img": [[0.0] * 64]}})
     assert status == 503
+
+
+def _post_with_headers(host, port, path, payload, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read()), \
+            dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_server_429_carries_retry_after():
+    """A load-shed reply must advertise its backoff hint: closed-loop
+    clients (and pload workers) re-offer shed work immediately
+    otherwise (docs/SERVING.md backpressure contract)."""
+    release = threading.Event()
+    engine = _SlowEngine(release)
+    server = InferenceServer(engine, ServerConfig(
+        port=0, max_batch=1, max_wait_ms=0, queue_size=1,
+        warmup=False, retry_after_s=2.0)).start()
+    host, port = server.address
+    try:
+        payload = {"inputs": {"img": [[0.0] * 4]}}
+        results = [None] * 8
+        threads = []
+
+        def client(i):
+            results[i] = _post_with_headers(host, port, "/v1/infer",
+                                            payload)
+
+        for i in range(8):
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 10
+        while not any(r and r[0] == 429 for r in results) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        release.set()
+        for t in threads:
+            t.join(timeout=30)
+        shed = [r for r in results if r and r[0] == 429]
+        assert shed, [r and r[0] for r in results]
+        for status, body, headers in shed:
+            assert headers.get("Retry-After") == "2", headers
+            assert body["request_id"]  # shed replies stay quotable
+        ok = [r for r in results if r and r[0] == 200]
+        assert ok and all("Retry-After" not in r[2] for r in ok)
+    finally:
+        server.shutdown()
+
+
+def test_concurrent_load_statuses_complete():
+    """The batcher deadline/504 path under concurrent submits: many
+    producers against a slow engine must each get exactly one of
+    200/429/504 — with a request_id — and no future may hang."""
+    release = threading.Event()
+    engine = _SlowEngine(release)
+    server = InferenceServer(engine, ServerConfig(
+        port=0, max_batch=2, max_wait_ms=0, queue_size=4,
+        warmup=False))
+    server.batcher.start()  # loopback: no HTTP listener needed
+    try:
+        n = 24
+        results = [None] * n
+        payload = {"inputs": {"img": [[0.0] * 4]}, "timeout_ms": 150}
+
+        def producer(i):
+            results[i] = server.handle_infer(dict(payload))
+
+        threads = [threading.Thread(target=producer, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        # the engine is blocked past every queued request's 150ms
+        # deadline: queued work expires (504), overflow sheds (429),
+        # the batch already in the engine completes (200)
+        time.sleep(0.4)
+        release.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(r is not None for r in results), \
+            "a submit hung: %r" % [i for i, r in enumerate(results)
+                                   if r is None]
+        statuses = [status for status, _ in results]
+        assert all(s in (200, 429, 504) for s in statuses), statuses
+        for status, body in results:
+            assert body.get("request_id"), (status, body)
+        assert 200 in statuses, statuses   # admitted work answered
+        assert 429 in statuses, statuses   # overflow shed
+        assert 504 in statuses, statuses   # expired at dequeue
+        # nothing left pending inside the batcher either
+        assert server.metrics.queue_depth.value == 0
+    finally:
+        server.batcher.close()
+
+
+def test_queue_depth_peak_high_watermark():
+    """The peak gauge keeps the worst depth between scrapes — set on
+    enqueue, dequeue AND the shed path — and a render resets the
+    window to the live depth."""
+    metrics = ServingMetrics()
+    metrics.note_queue_depth(3)
+    metrics.note_queue_depth(1)
+    assert metrics.queue_depth.value == 1
+    assert metrics.queue_depth_peak.value == 3
+    text = metrics.render_text()
+    assert "serving_queue_depth_peak 3" in text
+    # the scrape carried the watermark out; the window restarts at
+    # the live depth
+    assert metrics.queue_depth_peak.value == 1
+    assert "serving_queue_depth_peak 1" in metrics.render_text()
+
+    # the shed path publishes the saturated depth (an overflowing
+    # queue between enqueue/dequeue samples was formerly invisible)
+    release = threading.Event()
+    shed_metrics = ServingMetrics()
+    batcher = MicroBatcher(
+        _SlowEngine(release),
+        BatcherConfig(max_batch=1, max_wait_ms=0, queue_size=2),
+        metrics=shed_metrics).start()
+    try:
+        feeds = {"img": np.zeros((1, 4), np.float32)}
+        futures = [batcher.submit(feeds)]
+        with pytest.raises(QueueFullError):
+            for _ in range(16):
+                futures.append(batcher.submit(feeds))
+        assert shed_metrics.queue_depth.value >= 2
+        assert shed_metrics.queue_depth_peak.value >= 2
+        release.set()
+        for fut in futures:
+            fut.result(timeout=30)
+    finally:
+        batcher.close()
