@@ -206,7 +206,13 @@ class ServiceLease:
         # toward the beat interval is the early warning
         t0 = time.perf_counter()
         try:
-            faults_mod.check("coordinator/heartbeat")
+            fired = faults_mod.check("coordinator/heartbeat")
+            if fired is not None and fired.kind == "lease_expiry":
+                # the deterministic host-death drill: stall past the
+                # TTL so the master GENUINELY reclaims the lease —
+                # the next keep_alive finds it lapsed, exactly like a
+                # host that stopped heartbeating
+                time.sleep(self._ttl_ms / 1000.0 * 1.5 + 0.05)
             alive = self._client.keep_alive(self._lease)
         except (ConnectionError, OSError):
             _heartbeat_failures().inc()
@@ -322,10 +328,10 @@ class ElasticRegistry:
             time.sleep(min(0.05, ttl_ms / 1000.0))
 
     # -- discovery ------------------------------------------------------
-    def _list_rpc(self):
+    def _list_rpc(self, prefix):
         faults_mod.check("coordinator/discover")
         try:
-            return self._client.list_prefix(self.PS_PREFIX)
+            return self._client.list_prefix(prefix)
         except (ConnectionError, OSError):
             # the native transport never recovers a failed fd: swap in
             # a fresh connection so the NEXT retry attempt can succeed
@@ -338,9 +344,17 @@ class ElasticRegistry:
             self._client = native.MasterClient(self._host, self._port)
             raise
 
+    def list(self, prefix):
+        """{key: value} of unexpired leases under any `prefix` — the
+        generic discovery surface the elastic membership protocol
+        (resilience/elastic.py) reads views/acks/commits through, with
+        the same retry + `coordinator/discover` fault point as pserver
+        discovery."""
+        return self._retry.call(self._list_rpc, prefix)
+
     def pservers(self):
         """{slot: endpoint} of live pservers."""
-        entries = self._retry.call(self._list_rpc)
+        entries = self.list(self.PS_PREFIX)
         return {int(k[len(self.PS_PREFIX):]): v
                 for k, v in entries.items()}
 

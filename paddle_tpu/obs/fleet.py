@@ -61,7 +61,7 @@ DEFAULT_PUSH_PREFIXES = (
     "serving_responses_total", "serving_errors_total",
     "serving_total_seconds", "slo_burn_rate",
     "coordinator_heartbeat_", "supervisor_restarts_total",
-    "numerics_nonfinite_total", "fleet_snapshots_")
+    "numerics_nonfinite_total", "fleet_snapshots_", "elastic_")
 
 # env var a launcher sets to have workers report (cluster_launch.py
 # elastic mode exports it; coordinator.init_multihost honors it)

@@ -44,22 +44,35 @@ from . import faults
 from . import retry
 from .retry import RetryPolicy, CircuitBreaker
 
-__all__ = ["faults", "retry", "supervisor", "RetryPolicy",
-           "CircuitBreaker", "TrainingSupervisor"]
+__all__ = ["faults", "retry", "supervisor", "elastic", "RetryPolicy",
+           "CircuitBreaker", "TrainingSupervisor", "ElasticMembership",
+           "ElasticTrainer", "ClusterView"]
 
-
-def __getattr__(name):
+_LAZY = {
     # `supervisor` imports fluid.checkpoint, which imports this package
     # back for retry/faults — resolve it lazily to keep the package
     # import-cheap and cycle-free.  (import_module, not `from . import`:
     # the latter re-enters this __getattr__ through the fromlist
-    # hasattr check and recurses.)
-    if name in ("supervisor", "TrainingSupervisor"):
+    # hasattr check and recurses.)  `elastic` pulls in the spmd stack
+    # the same way.
+    "supervisor": ("supervisor", None),
+    "TrainingSupervisor": ("supervisor", "TrainingSupervisor"),
+    "elastic": ("elastic", None),
+    "ElasticMembership": ("elastic", "ElasticMembership"),
+    "ElasticTrainer": ("elastic", "ElasticTrainer"),
+    "ClusterView": ("elastic", "ClusterView"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
         import importlib
 
-        _supervisor = importlib.import_module(".supervisor", __name__)
-        globals()["supervisor"] = _supervisor
-        globals()["TrainingSupervisor"] = _supervisor.TrainingSupervisor
-        return globals()[name]
+        modname, attr = _LAZY[name]
+        mod = importlib.import_module("." + modname, __name__)
+        globals()[modname] = mod
+        value = mod if attr is None else getattr(mod, attr)
+        globals()[name] = value
+        return value
     raise AttributeError("module %r has no attribute %r"
                          % (__name__, name))

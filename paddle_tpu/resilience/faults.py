@@ -19,6 +19,9 @@ in production.  Points currently threaded through the stack:
     coordinator/register distributed/coordinator.py  register RPC
     coordinator/heartbeat  ..  one keep-alive RPC
     coordinator/discover   ..  one list_prefix RPC
+    elastic/propose      resilience/elastic.py  one view-change propose
+    elastic/commit       ..  one view-change commit
+    elastic/step         ..  one elastic worker step (run_elastic_worker)
     serving/run          serving/engine.py  one engine request
     supervisor/step      resilience/supervisor.py  one supervised step
 
@@ -33,6 +36,11 @@ Fault kinds:
     nonfinite  no side effect here; `check` returns the fired spec and
                the caller simulates the blowup (the supervisor replaces
                the step loss with NaN)
+    lease_expiry  no side effect here either; the coordinator's
+               heartbeat loop sees the fired spec and stalls past the
+               lease TTL, so the master GENUINELY reclaims the slot —
+               the deterministic stand-in for a host that stops
+               heartbeating (elastic shrink drills)
 
 Every fired fault increments `faults_injected_total{point,kind}` and
 emits a `fault_injected` trace instant, so a chaos run's artifacts
@@ -52,7 +60,8 @@ __all__ = ["FaultSpec", "FaultPlan", "InjectedIOError", "enable",
            "disable", "active", "get_plan", "inject", "check",
            "fired_counts"]
 
-KINDS = ("io_error", "latency", "preempt", "nonfinite")
+KINDS = ("io_error", "latency", "preempt", "nonfinite",
+         "lease_expiry")
 
 
 class InjectedIOError(IOError):

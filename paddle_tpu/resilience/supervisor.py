@@ -56,13 +56,28 @@ from . import faults as faults_mod
 from .retry import DEFAULT_RETRYABLE
 
 __all__ = ["TrainingSupervisor", "Preempted", "RestartBudgetExceeded",
-           "SUPERVISOR_META"]
+           "ElasticResized", "SUPERVISOR_META"]
 
 SUPERVISOR_META = "supervisor.json"
 
 
 class Preempted(Exception):
     """A preemption signal arrived; the urgent checkpoint is on disk."""
+
+
+class ElasticResized(Exception):
+    """The elastic membership layer committed a new cluster view and
+    already swapped the trainer onto it (mesh rebuilt, state restored
+    at the new layout).  A step loop raises this so the supervisor
+    counts the cycle as `reason="elastic_resize"` — distinct from
+    `preempt` — WITHOUT rolling the freshly re-placed state back to a
+    pre-resize snapshot."""
+
+    def __init__(self, generation, direction="shrink"):
+        super().__init__("elastic resize to generation %d (%s)"
+                         % (int(generation), direction))
+        self.generation = int(generation)
+        self.direction = direction
 
 
 class RestartBudgetExceeded(RuntimeError):
@@ -109,10 +124,14 @@ class TrainingSupervisor:
                  preempt_signals=(signal_mod.SIGTERM,
                                   signal_mod.SIGINT),
                  resume=True, state_dump=None, state_restore=None,
-                 saver=None):
+                 saver=None, generation=0):
         if on_preempt not in ("resume", "raise"):
             raise ValueError("on_preempt must be 'resume' or 'raise'")
         self.ckpt_dir = str(ckpt_dir)
+        # elastic generation of the view this supervisor serves; meta
+        # records it so auto-resume after a FULL-job restart picks the
+        # post-shrink view, not the launch-time one
+        self.generation = int(generation or 0)
         self.max_restarts = int(max_restarts)
         self.retryable = retryable
         self.loss_scaler = loss_scaler
@@ -175,6 +194,7 @@ class TrainingSupervisor:
         self._saver.wait()  # manifest + fsync done before meta lands
         meta = {"step": self._step, "epoch": self._epoch,
                 "batch": self._batch, "kind": kind,
+                "generation": self.generation,
                 "time": time.time()}
         if self.loss_scaler is not None:
             meta["loss_scale"] = self.loss_scaler.scale
@@ -230,6 +250,7 @@ class TrainingSupervisor:
         self._step = int(meta.get("step", step))
         self._epoch = int(meta.get("epoch", 0))
         self._batch = int(meta.get("batch", 0))
+        self.generation = int(meta.get("generation", self.generation))
         if self.loss_scaler is not None and "loss_scale" in meta:
             self.loss_scaler.set_scale(meta["loss_scale"])
         if self.state_restore is not None:
@@ -348,6 +369,13 @@ class TrainingSupervisor:
                     if self.on_preempt == "raise":
                         raise
                     reason = "preempt"
+                except ElasticResized as er:
+                    # the elastic layer already rebuilt the mesh and
+                    # re-placed the state at the NEW generation — count
+                    # the cycle, adopt the generation, and do NOT
+                    # restore (that would roll back the resize)
+                    reason = "elastic_resize"
+                    self.generation = er.generation
                 except _Rollback as rb:
                     reason = rb.reason
                 except Exception as exc:
@@ -366,7 +394,8 @@ class TrainingSupervisor:
                     raise RestartBudgetExceeded(
                         "gave up after %d restarts (last reason: %s)"
                         % (self._restarts - 1, reason))
-                self._restore_latest()
+                if reason != "elastic_resize":
+                    self._restore_latest()
                 if reason == "nonfinite" and self.loss_scaler is not None:
                     # back off AFTER the restore so the meta's scale
                     # (captured before the blowup) doesn't undo it

@@ -29,13 +29,16 @@ from .plan import (PartitionPlan, build_partition_plan,
                    match_partition_rules, load_rules)
 from .trainer import SpmdTrainer, attach_supervisor
 from .checkpoint import (SpmdCheckpointSaver, save_sharded,
-                         restore_sharded, latest_sharded_checkpoint)
+                         restore_sharded, latest_sharded_checkpoint,
+                         StaleGenerationError,
+                         measure_densify_restore)
 from .overlap import make_overlapped_dp_step, overlap_supported
 
 __all__ = [
     "PartitionPlan", "build_partition_plan", "match_partition_rules",
     "load_rules", "SpmdTrainer", "attach_supervisor",
     "SpmdCheckpointSaver", "save_sharded", "restore_sharded",
-    "latest_sharded_checkpoint", "make_overlapped_dp_step",
+    "latest_sharded_checkpoint", "StaleGenerationError",
+    "measure_densify_restore", "make_overlapped_dp_step",
     "overlap_supported",
 ]

@@ -43,11 +43,31 @@ from ..resilience import faults as faults_mod
 from ..resilience.retry import RetryPolicy
 
 __all__ = ["SpmdCheckpointSaver", "save_sharded", "restore_sharded",
-           "latest_sharded_checkpoint", "SPMD_MANIFEST"]
+           "latest_sharded_checkpoint", "SPMD_MANIFEST",
+           "StaleGenerationError", "measure_densify_restore"]
 
 SPMD_MANIFEST = "_spmd_manifest.json"
 HOST_MANIFEST = "_host_manifest.json"
 SPMD_CKPT_KIND = "spmd_sharded_checkpoint"
+
+
+class StaleGenerationError(RuntimeError):
+    """A sharded manifest carries a newer elastic generation than the
+    restoring process: the caller is a STALE host (it missed a view
+    change) and must not resurrect an old layout.  Deliberately not an
+    IOError — retry policies and the supervisor's transient-fault
+    restart loop must never paper over it."""
+
+    def __init__(self, snap, manifest_generation, caller_generation):
+        super().__init__(
+            "sharded checkpoint %s was written at elastic generation "
+            "%d but this process is at generation %d — a stale host "
+            "must rejoin the fleet (and adopt the committed view) "
+            "before restoring, not resurrect an old layout"
+            % (snap, manifest_generation, caller_generation))
+        self.snap = snap
+        self.manifest_generation = int(manifest_generation)
+        self.caller_generation = int(caller_generation)
 
 
 def _host_dir(process_index):
@@ -136,7 +156,8 @@ def _write_host_shards(snap, captured, process_index):
 
 
 def save_sharded(root, step, state, process_index=0, n_processes=1,
-                 mesh_axes=None, specs=None):
+                 mesh_axes=None, specs=None, generation=0,
+                 plan_fingerprint=None):
     """Write this host's shards of `state` under a new snapshot dir.
 
     Process 0 additionally writes the global `_spmd_manifest.json`
@@ -162,6 +183,11 @@ def save_sharded(root, step, state, process_index=0, n_processes=1,
             "mesh": dict(mesh_axes or {}),
             "specs": {n: list(s) if s is not None else None
                       for n, s in (specs or {}).items()},
+            # elastic identity: which cluster view trained this state,
+            # laid out by which plan — a view change is DETECTABLE at
+            # restore (generation guard + mesh/fingerprint mismatch)
+            "generation": int(generation or 0),
+            "plan_fingerprint": plan_fingerprint,
             "time": time.time(),
         }
         _atomic_json(snap, SPMD_MANIFEST, blob)
@@ -202,6 +228,8 @@ class _ShardReader:
             raise IOError("%s is not a sharded spmd checkpoint (kind=%r)"
                           % (snap, self.manifest.get("kind")))
         self.step = int(self.manifest["step"])
+        self.generation = int(self.manifest.get("generation", 0))
+        self.plan_fingerprint = self.manifest.get("plan_fingerprint")
         # var -> index_key -> (host_dir, entry); later hosts never
         # collide with earlier ones on a key (each host saves only the
         # replica-0 shards it owns)
@@ -257,7 +285,7 @@ class _ShardReader:
         return out
 
 
-def restore_sharded(snap, shardings, strict=True):
+def restore_sharded(snap, shardings, strict=True, max_generation=None):
     """Re-place a sharded snapshot onto the mesh WITHOUT densifying.
 
     snap: a snapshot dir (or a root — the newest complete snapshot is
@@ -266,12 +294,17 @@ def restore_sharded(snap, shardings, strict=True):
         trainer's step shardings).  Each addressable device loads
         exactly the saved shard covering its slice and the global
         arrays assemble via `make_array_from_single_device_arrays`.
+    max_generation: the caller's elastic generation; a manifest
+        stamped with a NEWER generation raises `StaleGenerationError`
+        naming both (a host that missed a view change must not
+        silently resurrect an old layout).  None skips the guard
+        (non-elastic jobs).
 
-    Returns (state, info): info carries "step" and "densified" — vars
-    whose saved slicing didn't match the target layout (mesh changed
-    between save and restore) and went through a dense host rebuild.
-    With strict=True, a var present in `shardings` but absent from
-    the snapshot raises.
+    Returns (state, info): info carries "step", "generation" and
+    "densified" — vars whose saved slicing didn't match the target
+    layout (mesh changed between save and restore) and went through a
+    dense host rebuild.  With strict=True, a var present in
+    `shardings` but absent from the snapshot raises.
     """
     if not os.path.exists(os.path.join(snap, SPMD_MANIFEST)):
         newest = latest_sharded_checkpoint(snap)
@@ -280,6 +313,10 @@ def restore_sharded(snap, shardings, strict=True):
                           % snap)
         snap = newest
     reader = _ShardReader(snap)
+    if max_generation is not None \
+            and reader.generation > int(max_generation):
+        raise StaleGenerationError(snap, reader.generation,
+                                   max_generation)
     state, densified = {}, []
     for name, sharding in shardings.items():
         ventry = reader.vars.get(name)
@@ -305,6 +342,7 @@ def restore_sharded(snap, shardings, strict=True):
         state[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, per_device)
     return state, {"step": reader.step, "snap": snap,
+                   "generation": reader.generation,
                    "densified": sorted(set(densified))}
 
 
@@ -356,11 +394,18 @@ class SpmdCheckpointSaver:
                         for e in spec] if spec is not None else None
         mesh_axes = {a: int(v) for a, v in
                      dict(self.trainer.mesh.shape).items()}
+        # elastic identity, captured NOW (the trainer may adopt a new
+        # view before the writer thread runs)
+        generation = getattr(self.trainer, "elastic_generation",
+                             None) or 0
+        plan = getattr(self.trainer, "plan", None)
+        plan_fp = plan.fingerprint() if plan is not None else None
         self._last_time = time.time()
         snap = os.path.join(self.root, "%s%09d" % (_PREFIX, int(step)))
         self._thread = threading.Thread(
             target=self._write, args=(snap, int(step), captured,
-                                      mesh_axes, specs), daemon=True)
+                                      mesh_axes, specs, generation,
+                                      plan_fp), daemon=True)
         self._thread.start()
         return snap
 
@@ -372,21 +417,26 @@ class SpmdCheckpointSaver:
             err, self._error = self._error, None
             raise err
 
-    def _write(self, snap, step, captured, mesh_axes, specs):
+    def _write(self, snap, step, captured, mesh_axes, specs,
+               generation, plan_fp):
         try:
             self._write_retry.call(self._write_once, snap, step,
-                                   captured, mesh_axes, specs)
+                                   captured, mesh_axes, specs,
+                                   generation, plan_fp)
             self._gc()
         except BaseException as e:  # surfaced on the next wait()/save()
             self._error = e
 
-    def _write_once(self, snap, step, captured, mesh_axes, specs):
+    def _write_once(self, snap, step, captured, mesh_axes, specs,
+                    generation, plan_fp):
         os.makedirs(snap, exist_ok=True)
         _write_host_shards(snap, captured, process_index=0)
         _atomic_json(snap, SPMD_MANIFEST, {
             "kind": SPMD_CKPT_KIND, "step": step, "n_processes": 1,
             "hosts": [_host_dir(0)], "vars": sorted(captured),
-            "mesh": mesh_axes, "specs": specs, "time": time.time(),
+            "mesh": mesh_axes, "specs": specs,
+            "generation": int(generation), "plan_fingerprint": plan_fp,
+            "time": time.time(),
         })
 
     def _gc(self):
@@ -415,10 +465,15 @@ class SpmdCheckpointSaver:
         if not candidates:
             return None
         last_err = None
+        max_gen = getattr(self.trainer, "elastic_generation", None)
         for snap in candidates:
             try:
+                # StaleGenerationError is a RuntimeError and escapes
+                # this loop on purpose: a stale host must stop, not
+                # fall back to an even older snapshot
                 state, info = restore_sharded(
-                    snap, self.trainer._shardings)
+                    snap, self.trainer._shardings,
+                    max_generation=max_gen)
             except (IOError, OSError, ValueError, KeyError) as e:
                 last_err = e
                 continue
@@ -432,3 +487,68 @@ class SpmdCheckpointSaver:
             return info["step"]
         raise IOError("no loadable sharded checkpoint under %r "
                       "(newest error: %s)" % (self.root, last_err))
+
+
+def measure_densify_restore(root, from_dp=8, to_dp=4, n_vars=4,
+                            rows=1024, cols=256, seed=0):
+    """Pin the cost of the layout-changed densify restore path.
+
+    Saves a synthetic `from_dp`-way dp-sharded state, then restores it
+    into a `to_dp`-way mesh — every var's saved slicing misses the
+    target slices when the split changed, so each goes through the
+    one-off dense reassembly (the elastic shrink's restore path).
+    Verifies the round-trip bit-exactly and returns a pmem-style blob
+    (`kind: paddle_tpu.densify_restore_measurement`) with the
+    reassembly throughput and, where the backend reports allocator
+    stats, the device peak watermark.  `pelastic densify-bench` prints
+    it; the sized test asserts on it.
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = jax.devices()
+    need = max(int(from_dp), int(to_dp))
+    if len(devices) < need:
+        raise ValueError("need %d devices for the measurement, have %d"
+                         % (need, len(devices)))
+    if rows % need:
+        raise ValueError("rows=%d not divisible by %d" % (rows, need))
+    rng = np.random.default_rng(seed)
+    mesh_from = Mesh(np.array(devices[:int(from_dp)]), ("dp",))
+    shard_from = NamedSharding(mesh_from, PartitionSpec("dp"))
+    originals = {"w%03d" % i:
+                 rng.standard_normal((int(rows), int(cols)))
+                 .astype(np.float32) for i in range(int(n_vars))}
+    state = {n: jax.device_put(a, shard_from)
+             for n, a in originals.items()}
+    snap = save_sharded(root, step=0, state=state,
+                        mesh_axes={"dp": int(from_dp)})
+    mesh_to = Mesh(np.array(devices[:int(to_dp)]), ("dp",))
+    shardings_to = {n: NamedSharding(mesh_to, PartitionSpec("dp"))
+                    for n in state}
+    t0 = time.perf_counter()
+    restored, info = restore_sharded(snap, shardings_to)
+    jax.block_until_ready(list(restored.values()))
+    seconds = time.perf_counter() - t0
+    for n, arr in originals.items():
+        if not np.array_equal(np.asarray(restored[n]), arr):
+            raise AssertionError(
+                "densify restore corrupted var %r" % n)
+    bytes_total = sum(a.nbytes for a in originals.values())
+    blob = {
+        "kind": "paddle_tpu.densify_restore_measurement", "version": 1,
+        "from_mesh": {"dp": int(from_dp)},
+        "to_mesh": {"dp": int(to_dp)},
+        "n_vars": int(n_vars), "bytes_total": int(bytes_total),
+        "densified": len(info["densified"]),
+        "seconds": round(seconds, 6),
+        "mib_per_s": round(bytes_total / (1 << 20) / seconds, 2)
+        if seconds > 0 else None,
+        "verified": True,
+    }
+    from ..obs import mem as mem_mod
+
+    marks = mem_mod.device_watermarks()
+    if marks:
+        blob["device_peak_bytes"] = max(
+            s.get("peak_bytes_in_use", 0) for s in marks.values())
+    return blob

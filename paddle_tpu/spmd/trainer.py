@@ -81,6 +81,11 @@ class SpmdTrainer(ParallelTrainer):
         self.overlap_fallback_reason = None
         self._fetch_all = list(fetch_names)
         self._aot_state = "pending" if self.use_pcache else "off"
+        # elastic membership identity: None = not elastic (no restore
+        # guard); the elastic layer (resilience/elastic.py) sets the
+        # committed view's generation here so checkpoints are stamped
+        # and stale restores refused
+        self.elastic_generation = None
 
     # -- plan-driven lowering hooks -----------------------------------------
     def _build_plan(self):
@@ -244,11 +249,14 @@ class SpmdTrainer(ParallelTrainer):
         saver.wait()
         return snap
 
-    def restore_checkpoint(self, root):
+    def restore_checkpoint(self, root, max_generation=None):
         """Restore the newest complete sharded snapshot under `root`
         into this trainer's shardings (shard-exact when the layout
         matches; densified reassembly only on a layout change).
-        Returns the restore info dict ({step, snap, densified})."""
+        `max_generation` defaults to the trainer's elastic generation
+        (when set) so a stale host refuses a newer manifest.
+        Returns the restore info dict ({step, snap, generation,
+        densified})."""
         from .checkpoint import (latest_sharded_checkpoint,
                                  restore_sharded)
 
@@ -256,7 +264,10 @@ class SpmdTrainer(ParallelTrainer):
         if snap is None:
             raise IOError("no complete sharded checkpoint under %r"
                           % str(root))
-        state, info = restore_sharded(snap, self._shardings)
+        if max_generation is None:
+            max_generation = self.elastic_generation
+        state, info = restore_sharded(snap, self._shardings,
+                                      max_generation=max_generation)
         self.state = state
         return info
 
@@ -282,5 +293,7 @@ def attach_supervisor(trainer, ckpt_dir, interval_secs=30.0,
     saver = SpmdCheckpointSaver(trainer, ckpt_dir,
                                 interval_secs=interval_secs,
                                 max_to_keep=max_to_keep)
+    kw.setdefault("generation",
+                  getattr(trainer, "elastic_generation", None) or 0)
     return TrainingSupervisor(ckpt_dir, scope=Scope(), saver=saver,
                               **kw)

@@ -1,0 +1,544 @@
+"""pelastic — elastic data-parallel training CLI + chaos drill.
+
+    # the CI entry point (scripts/ci.sh, scripts/smoke.sh):
+    python -m paddle_tpu.tools.elastic_cli --selftest
+
+    # one elastic worker process (the multi-process drill spawns two):
+    python -m paddle_tpu.tools.elastic_cli worker \
+        --master 127.0.0.1:7164 --host w0 --ckpt-root /tmp/ck \
+        --status /tmp/w0.json --steps 40
+
+    # pin the densify-restore reassembly cost (8 shards -> 4 shards):
+    python -m paddle_tpu.tools.elastic_cli densify-bench
+
+`--selftest` certifies the elastic contract end to end, three phases:
+
+  1. **protocol** — three in-process members bootstrap a view over a
+     real native master; one member's heartbeat is killed, its lease
+     expires, and the survivors commit a SHRINK at a higher
+     generation (with an injected `elastic/propose` IOError retried
+     along the way); the dead member rejoins and a GROW commits.
+  2. **resize** — a single-process simulated fleet (2 hosts × 4 CPU
+     devices) trains an MLP with zero1 state on a dp=8 mesh; losing a
+     host REALLY rebuilds the mesh at dp=4 and restores the sharded
+     snapshot through the densify path; the rejoin grows back to dp=8.
+     The densify-bench measurement runs here too.
+  3. **chaos** — two real worker processes on the simulated 8-device
+     CPU mesh; a fault plan inside one delivers a real SIGTERM
+     mid-step (`elastic/step:preempt`), the survivor commits a new
+     generation and continues at dp−1 with finite losses, restoring
+     shard-exact (`densified == []` — the layout held); a respawned
+     worker triggers the grow back.  The survivor's status file must
+     show `elastic_resizes_total`-equivalent history of EXACTLY one
+     shrink and one grow.
+
+See docs/DISTRIBUTED.md ("Elastic training") for the protocol and the
+runbook.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+GLOBAL_BATCH = 16
+DIM = 8
+CLASSES = 4
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="pelastic")
+    p.add_argument("--selftest", action="store_true",
+                   help="elastic certification: protocol drill + "
+                        "simulated-fleet resize + 2-process chaos "
+                        "drill")
+    sub = p.add_subparsers(dest="cmd")
+
+    w = sub.add_parser("worker", help="run one elastic worker process")
+    w.add_argument("--master", required=True,
+                   help="host:port of the native master")
+    w.add_argument("--host", required=True, help="this worker's host id")
+    w.add_argument("--ckpt-root", required=True)
+    w.add_argument("--status", default=None,
+                   help="path for per-step status JSON")
+    w.add_argument("--steps", type=int, default=40)
+    w.add_argument("--global-batch", type=int, default=GLOBAL_BATCH)
+    w.add_argument("--min-hosts", type=int, default=1)
+    w.add_argument("--save-every", type=int, default=3)
+    w.add_argument("--step-sleep", type=float, default=0.0)
+    w.add_argument("--ttl-ms", type=int, default=500)
+    w.add_argument("--hidden", type=int, default=64)
+    w.add_argument("--seed", type=int, default=7,
+                   help="fault-plan seed")
+    w.add_argument("--faults", default=None,
+                   help="comma list of point:kind[:after[:times]] "
+                        "(e.g. elastic/step:preempt:5:1)")
+
+    b = sub.add_parser("densify-bench",
+                       help="measure the 8-shard -> 4-shard densify "
+                            "restore")
+    b.add_argument("--from-dp", type=int, default=8)
+    b.add_argument("--to-dp", type=int, default=4)
+    b.add_argument("--vars", type=int, default=4)
+    b.add_argument("--rows", type=int, default=1024)
+    b.add_argument("--cols", type=int, default=256)
+
+    return p.parse_args(argv)
+
+
+def _builder(rows_fn, hidden):
+    """build_fn for ElasticTrainer: an MLP classifier whose batch dim
+    is re-derived from the committed view at every rebuild.  Same var
+    names every call (reset_unique_name) so the rebuilt state dict
+    lines up with the checkpointed one."""
+    import paddle_tpu.fluid as fluid
+
+    def build():
+        rows = rows_fn()
+        fluid.framework.reset_unique_name()
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[rows, DIM],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            label = fluid.layers.data(name="label", shape=[rows, 1],
+                                      dtype="int64",
+                                      append_batch_size=False)
+            h = fluid.layers.fc(input=x, size=hidden, act="relu")
+            logits = fluid.layers.fc(input=h, size=CLASSES, act=None)
+            loss = fluid.layers.softmax_with_cross_entropy(logits,
+                                                           label)
+            avg = fluid.layers.mean(loss)
+            fluid.optimizer.MomentumOptimizer(
+                learning_rate=0.1, momentum=0.9).minimize(avg)
+        return main, startup, ["x", "label"], [avg.name]
+
+    return build
+
+
+def _make_feeds_fn(global_batch):
+    import numpy as np
+
+    def make_feeds(step, start, stop):
+        # the FULL global batch is derived from the step alone, then
+        # sliced — every member of any view feeds disjoint rows of the
+        # same data, so a resize re-splits the same trajectory
+        rs = np.random.RandomState(1000 + int(step))
+        x = rs.rand(global_batch, DIM).astype(np.float32)
+        label = rs.randint(0, CLASSES, size=(global_batch, 1)) \
+            .astype(np.int64)
+        return {"x": x[start:stop], "label": label[start:stop]}
+
+    return make_feeds
+
+
+def run_worker(args):
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.elastic import (ElasticMembership,
+                                               feed_slice,
+                                               run_elastic_worker)
+
+    if args.faults:
+        faults.enable(seed=args.seed)
+        for item in args.faults.split(","):
+            parts = item.strip().split(":")
+            if len(parts) < 2:
+                raise SystemExit("bad fault spec %r (want "
+                                 "point:kind[:after[:times]])" % item)
+            faults.inject(parts[0], parts[1],
+                          after=int(parts[2]) if len(parts) > 2 else 0,
+                          times=int(parts[3]) if len(parts) > 3 else 1)
+
+    membership = ElasticMembership(args.master, host=args.host,
+                                   ttl_ms=args.ttl_ms)
+
+    def rows():
+        start, stop = feed_slice(args.host, membership.view.hosts,
+                                 args.global_batch)
+        return stop - start
+
+    try:
+        summary = run_elastic_worker(
+            membership, _builder(rows, args.hidden),
+            _make_feeds_fn(args.global_batch), args.ckpt_root,
+            steps=args.steps, global_batch=args.global_batch,
+            min_hosts=args.min_hosts, save_every=args.save_every,
+            status_path=args.status, step_sleep=args.step_sleep,
+            local=True)
+    finally:
+        faults.disable()
+        membership.close()
+    print("[pelastic] worker %s done: %s" % (args.host, json.dumps(
+        {k: summary[k] for k in ("host", "steps", "generation",
+                                 "preempted")})), flush=True)
+    return 0
+
+
+def run_densify_bench(args):
+    from paddle_tpu.spmd.checkpoint import measure_densify_restore
+
+    root = tempfile.mkdtemp(prefix="pelastic_densify_")
+    blob = measure_densify_restore(root, from_dp=args.from_dp,
+                                   to_dp=args.to_dp, n_vars=args.vars,
+                                   rows=args.rows, cols=args.cols)
+    print(json.dumps(blob, sort_keys=True), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def _poll_converged(members, predicate, timeout=15.0, dead=()):
+    """Drive every live member's protocol turn until `predicate(views)`
+    holds (views keyed by host)."""
+    deadline = time.time() + timeout
+    while True:
+        views = {}
+        for m in members:
+            if m in dead:
+                continue
+            try:
+                views[m.host] = m.poll()
+            except (IOError, OSError):
+                views[m.host] = m.view  # injected fault: next turn
+        if predicate(views):
+            return views
+        if time.time() >= deadline:
+            raise AssertionError("protocol did not converge: %r"
+                                 % views)
+        time.sleep(0.03)
+
+
+def _selftest_protocol():
+    """Phase 1: bootstrap/shrink/grow of the bare membership protocol
+    over a real master, with a lease ACTUALLY expiring (no
+    survivor-side guesses) and an injected propose fault retried."""
+    from paddle_tpu import native
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.elastic import ElasticMembership
+
+    ttl = 300
+    master = native.Master()
+    members = []
+    try:
+        faults.enable(seed=3)
+        # the FIRST propose attempt dies with an IOError; the leader's
+        # next poll turn must retry and still converge
+        propose_fault = faults.inject("elastic/propose", "io_error",
+                                      times=1)
+        for host in ("pa", "pb", "pc"):
+            members.append(ElasticMembership(
+                "127.0.0.1:%d" % master.port, host=host,
+                ttl_ms=ttl).join())
+        a, b, c = members
+
+        _poll_converged(members, lambda vs: all(
+            v.gen >= 1 and len(v.hosts) == 3 for v in vs.values()))
+        assert propose_fault.fired == 1, \
+            "elastic/propose fault never fired"
+        gen0 = a.view.gen
+        assert a.view.hosts == ["pa", "pb", "pc"]
+
+        # pb stops heartbeating (NOT a graceful leave): only the TTL
+        # reclaiming its lease may remove it from the live set
+        b._member_lease._stop.set()
+        b._member_lease._thread.join(timeout=5)
+        _poll_converged(members, lambda vs: all(
+            v.gen > gen0 and v.hosts == ["pa", "pc"]
+            for h, v in vs.items() if h != "pb"), dead=(b,))
+        assert a.view.reason == "host_lost", a.view
+        gen1 = a.view.gen
+
+        # pb rejoins (its orphaned lease must lapse first) -> grow
+        b._member_lease = None
+        b.join()
+        _poll_converged(members, lambda vs: all(
+            v.gen > gen1 and v.hosts == ["pa", "pb", "pc"]
+            for v in vs.values()))
+        assert a.view.reason == "rejoin", a.view
+        assert a.view.gen > gen1 > gen0 >= 1
+        return {"generations": [gen0, gen1, a.view.gen]}
+    finally:
+        faults.disable()
+        for m in members:
+            m.close()
+        master.stop()
+
+
+def _selftest_resize(workdir):
+    """Phase 2: the simulated fleet — a REAL mesh shrink dp=8 -> dp=4
+    with zero1 state restored through the densify path, then the grow
+    back."""
+    import numpy as np
+
+    from paddle_tpu import native
+    from paddle_tpu.resilience.elastic import (ElasticMembership,
+                                               ElasticTrainer)
+
+    ttl = 300
+    master = native.Master()
+    h0 = h1 = None
+    try:
+        h0 = ElasticMembership("127.0.0.1:%d" % master.port, host="h0",
+                               ttl_ms=ttl).join()
+        h1 = ElasticMembership("127.0.0.1:%d" % master.port, host="h1",
+                               ttl_ms=ttl).join()
+        et = ElasticTrainer(
+            h0, _builder(lambda: GLOBAL_BATCH, 1024),
+            os.path.join(workdir, "resize_ckpts"),
+            devices_per_host=4, zero_stage=1)
+        _poll_converged([h0, h1], lambda vs: all(
+            v.gen >= 1 and len(v.hosts) == 2 for v in vs.values()))
+        et.maybe_resize()
+        assert et.dp == 8, et.dp
+
+        def train(n, start_step):
+            # one FIXED batch throughout (step 0's): across two mesh
+            # rebuilds + restores the loss on it decreases iff the
+            # optimizer state genuinely carried over each resize
+            out = []
+            for i in range(n):
+                feeds = _make_feeds_fn(GLOBAL_BATCH)(0, 0, GLOBAL_BATCH)
+                out.append(float(np.asarray(
+                    et.step(feeds)[0]).reshape(-1)[0]))
+            return out
+
+        losses = train(4, 0)
+        et.save(4)
+
+        # h1 dies (heartbeat stops, lease expires) -> shrink to dp=4
+        h1._member_lease._stop.set()
+        h1._member_lease._thread.join(timeout=5)
+        deadline = time.time() + 15
+        shrink = None
+        while shrink is None:
+            assert time.time() < deadline, "shrink never committed"
+            shrink = et.maybe_resize(save_step=4)
+            time.sleep(0.03)
+        assert shrink["direction"] == "shrink", shrink
+        assert et.dp == 4, et.dp
+        assert shrink["densified"], \
+            "dp 8->4 with zero1 state should have densified " \
+            "something: %r" % shrink
+        losses += train(4, 4)
+        et.save(8)
+
+        # h1 rejoins -> grow back to dp=8 (densified again: 4->8)
+        h1._member_lease = None
+        h1.join()
+        deadline = time.time() + 15
+        grow = None
+        while grow is None:
+            assert time.time() < deadline, "grow never committed"
+            h1.poll()  # the rejoiner must ack the grow proposal
+            grow = et.maybe_resize(save_step=8)
+            time.sleep(0.03)
+        assert grow["direction"] == "grow", grow
+        assert et.dp == 8, et.dp
+        losses += train(4, 8)
+        assert all(np.isfinite(l) for l in losses), losses
+        assert losses[-1] < losses[0], losses
+        return {"losses": losses, "shrink": shrink, "grow": grow}
+    finally:
+        for m in (h0, h1):
+            if m is not None:
+                m.close()
+        master.stop()
+
+
+def _read_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (IOError, OSError, ValueError):
+        return None
+
+
+def _wait_status(path, predicate, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = _read_status(path)
+        if st is not None and predicate(st):
+            return st
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for %s (last status: %r)"
+                         % (what, _read_status(path)))
+
+
+def _spawn_worker(master_port, host, workdir, steps, faults=None):
+    status = os.path.join(workdir, "%s.status.json" % host)
+    cmd = [sys.executable, "-m", "paddle_tpu.tools.elastic_cli",
+           "worker", "--master", "127.0.0.1:%d" % master_port,
+           "--host", host, "--ckpt-root",
+           os.path.join(workdir, "ckpts"), "--status", status,
+           "--steps", str(steps), "--min-hosts", "2",
+           "--ttl-ms", "500", "--step-sleep", "0.08",
+           "--save-every", "3"]
+    if faults:
+        cmd += ["--faults", faults]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS",
+                   "--xla_force_host_platform_device_count=8")
+    log = open(os.path.join(workdir, "%s.log" % host), "w")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            env=env)
+    proc._log = log
+    return proc, status
+
+
+def _selftest_chaos(workdir):
+    """Phase 3: two real worker processes; a fault plan inside w1
+    delivers a real SIGTERM mid-step; the survivor shrinks and
+    continues shard-exact; a respawned w1 grows the fleet back."""
+    from paddle_tpu import native
+
+    steps = 60
+    master = native.Master()
+    procs = []
+    try:
+        w0, st0 = _spawn_worker(master.port, "w0", workdir, steps)
+        procs.append(w0)
+        # w1's own fault plan raises a REAL SIGTERM at its 6th step
+        w1, st1 = _spawn_worker(master.port, "w1", workdir, steps,
+                                faults="elastic/step:preempt:5:1")
+        procs.append(w1)
+
+        # both bound to the 2-host view and stepping
+        _wait_status(st0, lambda s: s["generation"] >= 1
+                     and s["n_hosts"] == 2 and s["step"] >= 2,
+                     90, "w0 to start on the 2-host view")
+        _wait_status(st1, lambda s: s["generation"] >= 1
+                     and s["n_hosts"] == 2 and s["step"] >= 2,
+                     90, "w1 to start on the 2-host view")
+
+        # the injected SIGTERM fires; w1 exits preempted, gracefully
+        assert w1.wait(timeout=60) == 0, "preempted worker exit code"
+        final1 = _wait_status(st1, lambda s: s.get("preempted"),
+                              10, "w1's preempted status")
+
+        # the survivor commits the shrink and keeps stepping at dp-1
+        shrunk = _wait_status(
+            st0, lambda s: s["n_hosts"] == 1 and any(
+                r["direction"] == "shrink" for r in s["resizes"]),
+            60, "w0 to commit the shrink")
+        step_at_shrink = shrunk["step"]
+        _wait_status(st0, lambda s: s["step"] > step_at_shrink + 1,
+                     60, "w0 to keep training after the shrink")
+
+        # a replacement registers under the same host id -> grow back
+        w1b, st1 = _spawn_worker(master.port, "w1", workdir, steps)
+        procs.append(w1b)
+        _wait_status(
+            st0, lambda s: s["n_hosts"] == 2 and any(
+                r["direction"] == "grow" for r in s["resizes"]),
+            90, "w0 to commit the grow")
+
+        assert w0.wait(timeout=180) == 0, "w0 exit code"
+        assert w1b.wait(timeout=180) == 0, "respawned w1 exit code"
+        final0 = _read_status(st0)
+
+        # the acceptance criterion: exactly one shrink and one grow in
+        # the survivor's committed history, shard-exact restores
+        # (the per-host layout held -> nothing densified), training
+        # completed with finite losses at a bumped generation
+        directions = [r["direction"] for r in final0["resizes"]]
+        assert directions.count("shrink") == 1 \
+            and directions.count("grow") == 1, directions
+        for r in final0["resizes"]:
+            assert r["densified"] == [], \
+                "chaos-drill restore densified %r (layout held — " \
+                "must be shard-exact)" % r
+        assert final0["done"] and final0["step"] == steps, final0
+        assert final0["generation"] >= 3, final0
+        for st in (final0, final1):
+            assert all(l is not None and l == l
+                       for l in st["losses"]), st
+        return {"w0": final0, "w1_preempted_at": final1["step"],
+                "resizes": final0["resizes"]}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            p._log.close()
+        master.stop()
+
+
+def selftest():
+    workdir = tempfile.mkdtemp(prefix="pelastic_")
+
+    proto = _selftest_protocol()
+    print("[pelastic] phase 1 (protocol) green: generations %s "
+          "(bootstrap -> lease-expiry shrink -> rejoin grow)"
+          % proto["generations"], flush=True)
+
+    resize = _selftest_resize(workdir)
+    print("[pelastic] phase 2 (resize) green: dp 8->4->8, shrink "
+          "densified %d var(s), grow densified %d, loss %.4f -> %.4f"
+          % (len(resize["shrink"]["densified"]),
+             len(resize["grow"]["densified"]),
+             resize["losses"][0], resize["losses"][-1]), flush=True)
+
+    from paddle_tpu.spmd.checkpoint import measure_densify_restore
+
+    bench = measure_densify_restore(
+        os.path.join(workdir, "densify_bench"))
+    assert bench["verified"] and bench["densified"] == bench["n_vars"]
+    print("[pelastic] densify-bench: %s"
+          % json.dumps(bench, sort_keys=True), flush=True)
+
+    chaos = _selftest_chaos(workdir)
+    print("[pelastic] phase 3 (chaos) green: w1 SIGTERM'd at step %d "
+          "by its fault plan, survivor resized %s and finished %d "
+          "steps at generation %d (workdir %s)"
+          % (chaos["w1_preempted_at"],
+             [(r["direction"], r["generation"])
+              for r in chaos["resizes"]],
+             chaos["w0"]["step"], chaos["w0"]["generation"], workdir),
+          flush=True)
+
+    # the in-process registry saw both directions (phases 1+2)
+    from paddle_tpu.obs import telemetry as obs_tele
+
+    snap = obs_tele.snapshot()
+    shrinks = sum(v for k, v in snap.items()
+                  if k.startswith("elastic_resizes_total{")
+                  and "direction=shrink" in k)
+    grows = sum(v for k, v in snap.items()
+                if k.startswith("elastic_resizes_total{")
+                and "direction=grow" in k)
+    assert shrinks >= 1 and grows >= 1, snap
+    print("[pelastic] selftest green: elastic_resizes_total "
+          "shrink=%d grow=%d, elastic_generation=%s"
+          % (shrinks, grows, snap.get("elastic_generation")),
+          flush=True)
+    return 0
+
+
+def main(argv=None):
+    # elastic drills must never contend for a real accelerator, and
+    # the simulated fleet needs its 8 virtual CPU devices
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    args = parse_args(argv)
+    if args.cmd == "worker":
+        os.environ.setdefault("PADDLE_FLEET_HOST", args.host)
+        return run_worker(args)
+    if args.cmd == "densify-bench":
+        return run_densify_bench(args)
+    if args.selftest:
+        return selftest()
+    parse_args(["--help"])
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,9 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[ci] chaos selftest (injected I/O fault + SIGTERM preemption + nonfinite step; supervised run must match fault-free params) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
+echo "[ci] pelastic selftest (two-phase view-change protocol over a real master with lease expiry, simulated-fleet dp 8->4->8 with densify restore, 2 real workers with one SIGTERM'd mid-step: shrink commit + shard-exact continue + rejoin grow) ..."
+timeout 600 python -m paddle_tpu.tools.elastic_cli --selftest
+
 echo "[ci] pcc selftest (cold compile populates cache, restart reload = 0 XLA compiles, corrupt entry quarantined, rewrite passes bit-identical, layout+fuse pipeline keys distinct + warm reloads) ..."
 timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 

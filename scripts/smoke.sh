@@ -28,6 +28,9 @@ timeout 300 python -m paddle_tpu.tools.obs_dump --selftest
 echo "[smoke] chaos selftest (injected I/O fault + preemption + nonfinite; auto-resume must match fault-free run) ..."
 timeout 300 python -m paddle_tpu.tools.chaos_cli --selftest
 
+echo "[smoke] pelastic selftest (view-change protocol + simulated-fleet shrink/grow + 2-worker SIGTERM chaos drill) ..."
+timeout 600 python -m paddle_tpu.tools.elastic_cli --selftest
+
 echo "[smoke] pcc selftest (persistent compile cache: cold->warm reload, quarantine, rewrite passes incl. layout+fuse opt pipeline) ..."
 timeout 300 python -m paddle_tpu.tools.pcache_cli --selftest
 

@@ -49,6 +49,7 @@ setup(
             "pshard=paddle_tpu.tools.shard_cli:main",
             "pcomm=paddle_tpu.tools.comm_cli:main",
             "pload=paddle_tpu.tools.load_cli:main",
+            "pelastic=paddle_tpu.tools.elastic_cli:main",
         ],
     },
 )
