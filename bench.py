@@ -566,7 +566,10 @@ def main():
 
     state[RNG_STATE_NAME] = jax.device_put(jax.random.PRNGKey(0), dev)
 
-    step = jax.jit(lambda s, f: fp(s, f), donate_argnums=(0,))
+    from paddle_tpu.analysis.alias import state_donation
+
+    step = jax.jit(lambda s, f: fp(s, f),
+                   donate_argnums=(0,) if state_donation() else ())
     if prefetch:
         from paddle_tpu.reader.prefetch import device_prefetch
 
@@ -678,6 +681,7 @@ def main():
     # one.  BENCH_MEMORY=0 opts out (mega_bench sets it for RISKY
     # legs).
     mem_blob = None
+    donation_blob = None
     if os.environ.get("BENCH_MEMORY", "1") != "0":
         try:
             from paddle_tpu.obs import mem as obs_mem
@@ -687,6 +691,18 @@ def main():
                 xla_stats=xla_stats)
         except Exception as exc:  # noqa: BLE001 — same contract as
             print("bench: memory blob failed: %r" % (exc,),  # perf
+                  file=sys.stderr, flush=True)
+        # the donation blob: what the alias analysis planned vs what
+        # the flag/backend let through (planned/donated/declined
+        # bytes + per-A-code decline attribution) — the record says
+        # whether this run's step actually reused its state HBM
+        try:
+            from paddle_tpu.obs import mem as obs_mem
+
+            donation_blob = obs_mem.bench_donation_blob(
+                main_prog, fetches=[avg_loss.name])
+        except Exception as exc:  # noqa: BLE001 — same contract
+            print("bench: donation blob failed: %r" % (exc,),
                   file=sys.stderr, flush=True)
     metric = _tagged(metric, rcp, micro, prefetch)
     record = {
@@ -706,6 +722,7 @@ def main():
         "platform": dev.platform + ("-fallback" if fallback else ""),
         "perf": perf_blob,
         "memory": mem_blob,
+        "donation": donation_blob,
         # the candidate point this record measured (tune/fit.py joins
         # history rows back to their plan entry through this)
         "config": _config_blob(

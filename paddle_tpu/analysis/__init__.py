@@ -18,6 +18,10 @@ Three layers (docs/ANALYSIS.md documents every diagnostic code):
     checks, per-device peak-HBM estimation (S0xx codes), with the
     `costmodel` pricing the implied ICI collectives
     (`shard_comm_bytes_total{collective}`).
+  * `alias`     — may-alias + last-use donation-safety analysis: per
+    jit segment, which param/state buffers are provably donatable
+    (A0xx codes); the executor consumes the resulting `DonationPlan`
+    behind FLAGS_donation and `pmem audit` prices what it declines.
 
 `check_program` runs all three and publishes finding counters into the
 obs registry; the sibling roofline COST analyzer lives in
@@ -38,12 +42,16 @@ from .verifier import verify_program
 from .shard import (analyze_sharding, check_moe, check_pipeline,
                     check_ring, mesh_axis_sizes, ShardingPlan)
 from .costmodel import CommCostReport
+from .alias import (analyze_donation, donation_mode, DonationPlan,
+                    state_donation)
 
 __all__ = [
     "Diagnostic", "Severity", "Report", "ProgramVerificationError",
     "Liveness", "verify_program", "analyze_dataflow", "lint_program",
     "check_program", "analyze_sharding", "check_pipeline", "check_moe",
     "check_ring", "mesh_axis_sizes", "ShardingPlan", "CommCostReport",
+    "analyze_donation", "donation_mode", "DonationPlan",
+    "state_donation",
 ]
 
 

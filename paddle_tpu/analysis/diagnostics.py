@@ -13,6 +13,7 @@ Code families:
   V0xx  structural verification (verifier.py)
   D0xx / H0xx  dataflow: dead code and write/alias hazards (dataflow.py)
   L0xx  TPU-specific lints (lints.py)
+  A0xx  alias & donation safety (alias.py)
 
 Suppressions are strings, matched most-specific-first:
   "H002"              suppress the code everywhere

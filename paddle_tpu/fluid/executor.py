@@ -332,6 +332,7 @@ class _CompiledProgram:
         # _aot_acquire); None until then
         self._pcache_base_fp = None
         self._plan = self._analyze()
+        self._donation = self._donation_setup()
 
     # -- data-flow analysis -------------------------------------------------
     def _analyze(self):
@@ -384,6 +385,36 @@ class _CompiledProgram:
                 "persist_writes": persist_writes, "rng": rng,
             })
         return plan
+
+    def _donation_setup(self):
+        """Resolve FLAGS_donation into what _run_jit_segment applies:
+        {"mode": off|conservative|auto, "widened": [tuple per segment]
+        or None}.  Only "auto" runs the donation-safety analysis
+        (analysis/alias.py) — and any analysis failure degrades to
+        "conservative": the plan must never be the reason a step
+        fails.  "auto" also degrades when the backend's executable
+        reload drops donation aliasing (A005)."""
+        from .. import analysis
+
+        mode = analysis.donation_mode()
+        if mode != "auto":
+            return {"mode": mode, "widened": None}
+        try:
+            from ..compile import pcache as pcache_mod
+
+            plan = analysis.analyze_donation(
+                self.program, fetches=self.fetch_names,
+                feeds=self.feed_names,
+                backend_safe=pcache_mod.donation_aliasing_safe())
+            if plan.effective_mode != "auto":
+                return {"mode": plan.effective_mode, "widened": None}
+            return {"mode": "auto",
+                    "widened": [tuple(s["widened"])
+                                for s in plan.segments]}
+        except Exception:
+            _log.debug("donation analysis failed; falling back to "
+                       "conservative donation", exc_info=True)
+            return {"mode": "conservative", "widened": None}
 
     # -- execution ----------------------------------------------------------
     def run(self, scope, feed_env, eager=False):
@@ -475,6 +506,17 @@ class _CompiledProgram:
             block_idx = self.block_idx
             executor = self.executor
             mutated = tuple(n for n in seg["outputs"] if n in seg["reads"])
+            dn = self._donation
+            if dn["mode"] == "off":
+                mutated = ()
+            elif dn["mode"] == "auto" and dn["widened"] \
+                    and i < len(dn["widened"]):
+                # the A0xx analysis proved these reads dead after the
+                # segment — donate them too (reads-membership re-check
+                # keeps a stale plan from widening past the signature)
+                mutated += tuple(n for n in dn["widened"][i]
+                                 if n not in mutated
+                                 and n in seg["reads"])
 
             def segment_fn(mut_ins, ro_ins, rng):
                 env = dict(ro_ins)
@@ -646,7 +688,7 @@ class _CompiledProgram:
                 fetches=self.fetch_names,
                 flag_items=[(k, flags.get_flag(k)) for k in
                             ("amp_bf16", "amp_bf16_act",
-                             "bn_shifted_stats")],
+                             "bn_shifted_stats", "donation")],
                 pipeline_id=passes_mod.pipeline_id(
                     flags.get_flag("compile_passes")))
             self._pcache_base_fp = fp_mod.combine(
@@ -886,7 +928,8 @@ class Executor:
                    flags.get_flag("amp_bf16"),
                    flags.get_flag("amp_bf16_act"),
                    flags.get_flag("bn_shifted_stats"),
-                   flags.get_flag("compile_passes"))
+                   flags.get_flag("compile_passes"),
+                   flags.get_flag("donation"))
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 # verify-before-first-compile (FLAGS_verify_program):

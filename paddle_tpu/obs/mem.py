@@ -557,35 +557,28 @@ def load_store(path):
 # donation audit
 # ---------------------------------------------------------------------------
 
-def audit_donation(program, fetches=()):
-    """Walk the optimizer's `in_place_outputs` declarations against
-    the jit signature the executor will actually donate, and report
-    param/optimizer-state buffers that are dead-after-use but NOT
-    donated, with the bytes reclaimable.
+def audit_donation(program, fetches=(), mode=None):
+    """Price the donation-safety analysis (`analysis/alias.py`): which
+    param/optimizer-state buffers the executor's jit signature donates
+    under the requested FLAGS_donation mode, and which dead-after-use
+    buffers it does NOT — each reclaimable entry cross-linked to the
+    A-code explaining the refusal (A001 forked/absent in-place slot,
+    A004 update stranded in a non-jittable segment; code None only
+    under mode=off, where the flag itself is the refusal).
 
-    The executor donates exactly `mutated = outputs ∩ reads` of each
-    jittable segment (`_CompiledProgram._run_jit_segment`,
-    donate_argnums=(0,)) — an in-place update that writes the SAME
-    var name it reads donates for free.  What leaks:
-
-      * a forked slot (H003 class): `Moment1Out` writing a different
-        var than `Moment1` — the old state buffer is dead after the
-        op but XLA sees two distinct buffers, no donation;
-      * a dropped alias: a declared in-place out slot missing from
-        the op entirely, stranding the input buffer;
-      * an update stranded in a non-jittable segment (host op in the
-        chain): eager execution never donates.
-
+    mode: "auto" | "conservative" | "off"; None reads FLAGS_donation.
     Returns {"donated": [...], "reclaimable": [...]} entries with
     name/bytes/op identity; `reclaimable_bytes` is the audit's
     headline number."""
-    from ..analysis.dataflow import Liveness, _in_place_pairs
+    from ..analysis.alias import analyze_donation
     from ..fluid import analysis as fluid_analysis
-    from ..fluid.executor import _segment_block
 
     desc = getattr(program, "desc", program)
     bd = desc.block(0)
     bf16_act = _bf16_act_now()
+    # zero-device audit: backend_safe=None skips the A005 backend
+    # consultation — the executor re-asks at jit build
+    plan = analyze_donation(program, fetches=fetches, mode=mode)
 
     def full_bytes(name):
         vd = bd.vars.get(name)
@@ -604,81 +597,29 @@ def audit_donation(program, fetches=()):
             return "optimizer_state"
         return "activation"
 
-    lv = Liveness(bd.ops, final_live=set(fetches or ())).analyze()
-    use_sites = lv.use_sites()
-    segments_plan = _segment_block(bd.ops)
-
     donated, reclaimable = [], []
-    base = 0
-    for jit_ok, ops in segments_plan:
-        # replicate the executor's per-segment signature: writes that
-        # leave the segment (read later or persistable) are outputs,
-        # and outputs ∩ reads is the donated set
-        reads, writes = set(), set()
-        for od in ops:
-            reads.update(od.input_names())
-            writes.update(n for n in od.output_names()
-                          if n != "@EMPTY@")
-        end = base + len(ops)
-        needed_later = set(fetches or ())
-        for od in bd.ops[end:]:
-            needed_later.update(od.input_names())
-        persist = {n for n in writes
-                   if bd.vars.get(n) is not None
-                   and bd.vars[n].persistable}
-        outputs = {n for n in writes
-                   if n in needed_later or n in persist}
-        mutated = outputs & reads if jit_ok else set()
-
-        for off, od in enumerate(ops):
-            op_idx = base + off
-            for out_slot, in_slot in _in_place_pairs(od):
-                outs = od.output(out_slot)
-                ins = od.input(in_slot) if in_slot else []
-                for k, in_name in enumerate(ins):
-                    if in_name == "@EMPTY@":
-                        continue
-                    out_name = outs[k] if k < len(outs) else None
-                    nbytes = full_bytes(in_name)
-                    item = {"name": in_name, "bytes": int(nbytes),
-                            "op_index": op_idx, "op_type": od.type,
-                            "slot": out_slot,
-                            "kind": kind_of(in_name, out_slot)}
-                    if out_name == in_name and in_name in mutated:
-                        donated.append(item)
-                        continue
-                    # old value dead after this op?  (a later reader
-                    # would legitimately pin the buffer)
-                    later_reads = [u for u in
-                                   use_sites.get(in_name, ())
-                                   if u > op_idx]
-                    if later_reads or in_name in (fetches or ()):
-                        continue
-                    if out_name == in_name and not jit_ok:
-                        item["reason"] = (
-                            "in-place update runs in a non-jittable "
-                            "segment — eager execution never donates")
-                    elif out_name is None:
-                        item["reason"] = (
-                            "declared in-place slot %r is absent from "
-                            "the op; the input buffer is stranded"
-                            % out_slot)
-                    elif out_name != in_name:
-                        item["reason"] = (
-                            "in-place slot %r forks %r -> %r; XLA "
-                            "sees two buffers, no donation"
-                            % (out_slot, in_name, out_name))
-                    else:
-                        # same name but not in the donated signature
-                        # (not an output of its segment): dead write,
-                        # nothing to reclaim
-                        continue
-                    reclaimable.append(item)
-        base = end
+    for e in plan.entries:
+        if e["status"] not in ("donated", "reclaimable"):
+            continue
+        item = {"name": e["name"], "bytes": int(full_bytes(e["name"])),
+                "op_index": e["op_index"], "op_type": e["op_type"],
+                "slot": e["slot"],
+                "kind": kind_of(e["name"], e["slot"])}
+        if e["status"] == "donated":
+            donated.append(item)
+        else:
+            item["reason"] = e["reason"]
+            if e["code"]:
+                item["code"] = e["code"]
+            reclaimable.append(item)
     return {
-        "kind": "paddle_tpu.mem_donation_audit", "version": 1,
+        "kind": "paddle_tpu.mem_donation_audit", "version": 2,
         "ops": len(bd.ops), "jit_segments": sum(
-            1 for j, _ in segments_plan if j),
+            1 for s in plan.segments if s["jit"]),
+        "mode": plan.mode,
+        "effective_mode": plan.effective_mode,
+        "widened": sorted(n for s in plan.segments
+                          for n in s["widened"]),
         "donated": donated,
         "donated_bytes": sum(d["bytes"] for d in donated),
         "reclaimable": reclaimable,
@@ -698,11 +639,72 @@ def render_audit(audit):
         lines.append("  RECLAIM %-36s %10.2f MiB  [%s] op %d %s/%s"
                      % (r["name"], r["bytes"] / MiB, r["kind"],
                         r["op_index"], r["op_type"], r["slot"]))
-        lines.append("          %s" % r["reason"])
+        lines.append("          %s%s"
+                     % (("%s: " % r["code"]) if r.get("code") else "",
+                        r["reason"]))
     if not audit["reclaimable"]:
         lines.append("  every dead-after-use param/state buffer is "
                      "donated — nothing to reclaim")
     return "\n".join(lines)
+
+
+def bench_donation_blob(program, fetches=()):
+    """The BENCH record's `donation` blob: the plan's verdict in bytes
+    — planned (everything provably donatable), donated (what the
+    effective mode actually donates, widened buffers included), and
+    declined (refusals, split by A-code) — so `pperf gate
+    --mem-tolerance` can lock the peak-HBM win in CI."""
+    from ..analysis.alias import analyze_donation
+    from ..fluid import analysis as fluid_analysis
+
+    desc = getattr(program, "desc", program)
+    bd = desc.block(0)
+    bf16_act = _bf16_act_now()
+    plan = analyze_donation(program, fetches=fetches)
+
+    def full_bytes(name):
+        vd = bd.vars.get(name)
+        if vd is None or vd.shape is None:
+            return 0
+        return fluid_analysis._numel(vd.shape) * \
+            fluid_analysis._elem_bytes(str(vd.dtype), True, bf16_act)
+
+    donated = declined = 0
+    declined_by_code = {}
+    for e in plan.entries:
+        if e["status"] == "donated":
+            donated += full_bytes(e["name"])
+        elif e["status"] == "reclaimable":
+            b = full_bytes(e["name"])
+            declined += b
+            code = e["code"] or "off"
+            declined_by_code[code] = declined_by_code.get(code, 0) + b
+    for s in plan.segments:
+        for n in s["widened"]:
+            b = full_bytes(n)
+            if plan.effective_mode == "auto":
+                donated += b
+            else:
+                # proven donatable but the effective mode declines it
+                # (off, or auto degraded to conservative via A005)
+                declined += b
+                declined_by_code[plan.effective_mode] = \
+                    declined_by_code.get(plan.effective_mode, 0) + b
+        for d in s["declined"]:
+            b = full_bytes(d["name"])
+            declined += b
+            declined_by_code[d["code"]] = \
+                declined_by_code.get(d["code"], 0) + b
+    return {
+        "mode": plan.mode,
+        "effective_mode": plan.effective_mode,
+        "fingerprint": plan.fingerprint(),
+        "planned_bytes": int(donated + declined),
+        "donated_bytes": int(donated),
+        "declined_bytes": int(declined),
+        "declined_by_code": {k: int(v) for k, v in
+                             sorted(declined_by_code.items())},
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -50,9 +50,14 @@ def verify_sharding(program, mesh, feed_names, fetch_names,
 
 def make_parallel_step(program, feed_names, fetch_names, mesh,
                        state_template, dp_axis="dp", mp_axis="mp",
-                       donate_state=True, fp=None, zero_stage=0,
+                       donate_state=None, fp=None, zero_stage=0,
                        feed_specs=None, spec_overrides=None):
     """Compile a Program block into a sharded step function.
+
+    donate_state: None (default) routes through the donation plan —
+    FLAGS_donation=off disables state donation, any other mode keeps
+    it (analysis.state_donation); pass an explicit bool to override
+    (the AOT "-nodonate" twin and obs.comm's compute-only twin do).
 
     Returns (step, state_shardings) where
       step(state, feeds, rng) -> (fetches, new_state)
@@ -79,6 +84,10 @@ def make_parallel_step(program, feed_names, fetch_names, mesh,
     ParallelTrainer.init verifies before running startup) and rejects
     S0xx errors before any lowering.
     """
+    if donate_state is None:
+        from ..analysis.alias import state_donation
+
+        donate_state = state_donation()
     if fp is None:
         if program is not None and _flags.get_flag("verify_sharding"):
             verify_sharding(program, mesh, feed_names, fetch_names,

@@ -98,7 +98,7 @@ def overlap_supported(program, mesh, dp_axis="dp", zero_stage=0):
 def make_overlapped_dp_step(program, feed_names, fetch_names, mesh,
                             state_template, dp_axis="dp",
                             bucket_bytes=DEFAULT_BUCKET_BYTES,
-                            donate_state=True, feed_specs=None,
+                            donate_state=None, feed_specs=None,
                             skip_reduce=False):
     """Compile the program into the overlapped explicit-dp step.
 
@@ -108,6 +108,11 @@ def make_overlapped_dp_step(program, feed_names, fetch_names, mesh,
     fetches returned as the cross-shard mean (== the global-batch
     value).  Callers gate on `overlap_supported` first.
 
+    donate_state: None (default) routes through the donation plan —
+    FLAGS_donation=off disables state donation, any other mode keeps
+    it (analysis.state_donation); an explicit bool overrides (the
+    compute-only comm twin passes False to keep its state alive).
+
     skip_reduce=True elides the bucketed ring entirely — the
     optimizer applies LOCAL gradients, so the result is numerically
     WRONG across shards.  It exists for one purpose: the compute-only
@@ -116,6 +121,10 @@ def make_overlapped_dp_step(program, feed_names, fetch_names, mesh,
     (pair it with donate_state=False to keep the measured trainer's
     state buffers alive).
     """
+    if donate_state is None:
+        from ..analysis.alias import state_donation
+
+        donate_state = state_donation()
     ok, reason = overlap_supported(program, mesh, dp_axis=dp_axis)
     if not ok:
         raise ValueError("overlapped dp step unsupported: %s" % reason)

@@ -39,7 +39,8 @@ __all__ = ["SpmdTrainer", "attach_supervisor"]
 
 # the flag set that changes what a train-step trace contains — must
 # match the executor's pcache key discipline (fluid/executor.py)
-_TRACE_FLAGS = ("amp_bf16", "amp_bf16_act", "bn_shifted_stats")
+_TRACE_FLAGS = ("amp_bf16", "amp_bf16_act", "bn_shifted_stats",
+                "donation")
 
 
 class SpmdTrainer(ParallelTrainer):
@@ -121,7 +122,14 @@ class SpmdTrainer(ParallelTrainer):
                        for a, s in dict(self.mesh.shape).items()},
             plan_fingerprint=self.plan.fingerprint())
 
-    def _make_step(self, fp, state, fetch_all, donate_state=True):
+    def _make_step(self, fp, state, fetch_all, donate_state=None):
+        # donate_state None routes through the donation plan (the
+        # FLAGS_donation gate, analysis.state_donation); the AOT
+        # "-nodonate" twin passes an explicit False
+        if donate_state is None:
+            from ..analysis.alias import state_donation
+
+            donate_state = state_donation()
         if self.plan is None:       # init() not used (tests drive
             self.plan = self._build_plan()  # _make_step directly)
         self._fetch_all = list(fetch_all)

@@ -8,8 +8,14 @@
     python -m paddle_tpu.tools.lint_cli path/to/model_dir \
         --mesh dp=4,mp=2 --hbm-gb 16
 
+    # additionally run the A0xx donation-safety analysis
+    # (analysis/alias.py): which buffers each jit segment can donate,
+    # and why the rest are refused:
+    python -m paddle_tpu.tools.lint_cli path/to/model_dir --donation
+
     # lint the checked-in golden program fixtures (the pre-push hook
-    # passes --mesh dp=4,mp=2 so the pinned IR must also shard clean):
+    # passes --mesh dp=4,mp=2 --donation so the pinned IR must also
+    # shard AND donation-plan clean):
     python -m paddle_tpu.tools.lint_cli --golden
 
     # the CI entry point (scripts/ci.sh, scripts/smoke.sh):
@@ -32,7 +38,12 @@ clean lenet5 training program AND every golden fixture over the four
 dryrun mesh shapes (dp/mp, dp/mp/sp, pp/dp, dp/ep) asserting zero
 errors, and seeds one corruption per S0xx code (unmatched rule,
 non-divisible batch, conflicting layouts, schedule mismatch, HBM
-budget) asserting each exact code.
+budget) asserting each exact code.  The donation leg does the same
+for the A0xx family: lenet5 + golden fixtures plan clean, then one
+seeded corruption per code — forked Adam slot (A001), plan replayed
+over a program with a late reader (A002), fetched donatable
+intermediate (A003), in-place update in a non-jit segment (A004),
+donation-unsafe backend (A005) — each asserting its exact code.
 """
 
 import argparse
@@ -73,6 +84,11 @@ def parse_args(argv=None):
                         "default+layout+fuse+auto_remat) BEFORE "
                         "linting — proves a pass can never emit a "
                         "program the linter would reject")
+    p.add_argument("--donation", action="store_true",
+                   help="also run the A0xx donation-safety analysis "
+                        "(analysis/alias.py): per jit segment, which "
+                        "buffers are provably donatable and why the "
+                        "rest are refused; no devices needed")
     p.add_argument("--suppress", default=None,
                    help="comma-separated suppressions, e.g. "
                         "H002,L003@dropout,D002@var:tmp_0")
@@ -114,12 +130,32 @@ def _shard_analyze(desc, args, report, fetches=None):
     return plan
 
 
-def _report_exit(name, report, args, plan=None):
+def _donation_analyze(desc, args, report, fetches=None):
+    """Run the donation-safety analysis under --donation, merging A0xx
+    findings into `report`; returns the DonationPlan (None without
+    --donation)."""
+    if not args.donation:
+        return None
+    from paddle_tpu import analysis
+
+    before = len(report.diagnostics)
+    plan = analysis.analyze_donation(desc, fetches=fetches or (),
+                                     report=report, publish=False)
+    # same contract as _shard_analyze: count only the findings this
+    # analysis added, never re-publish the merged report
+    analysis.Report(report.diagnostics[before:]).publish(
+        origin="lint_cli_donation")
+    return plan
+
+
+def _report_exit(name, report, args, plan=None, donation=None):
     if args.json:
         doc = report.to_dict()
         doc["target"] = name
         if plan is not None:
             doc["sharding"] = plan.to_dict()
+        if donation is not None:
+            doc["donation"] = donation.to_dict()
         print(json.dumps(doc, indent=1, sort_keys=True))
     else:
         shown = report.sorted()
@@ -133,6 +169,16 @@ def _report_exit(name, report, args, plan=None):
                   % (name, dict(plan.mesh_axes),
                      {k: int(v) for k, v in comm.items()} or "none",
                      (plan.peak_hbm_bytes or 0) / 2**30))
+        if donation is not None:
+            donate = sum(len(donation.donate(i))
+                         for i in range(len(donation.segments)))
+            refused = sum(1 for e in donation.entries
+                          if e["status"] == "reclaimable") \
+                + sum(len(s["declined"]) for s in donation.segments)
+            print("[lint] %s: donation mode=%s(effective %s) "
+                  "donates %d buffer(s)/step, %d refused, plan %s"
+                  % (name, donation.mode, donation.effective_mode,
+                     donate, refused, donation.fingerprint()))
         print("[lint] %s: %d error(s), %d warning(s), %d info, "
               "%d suppressed"
               % (name, len(report.errors), len(report.warnings),
@@ -157,7 +203,9 @@ def lint_model_dir(args):
         bucket_hints=meta.get("bucket_hints"),
         suppress=_split(args.suppress), origin="lint_cli")
     plan = _shard_analyze(desc, args, report, fetches=fetches)
-    return _report_exit(args.model_dir, report, args, plan=plan)
+    dplan = _donation_analyze(desc, args, report, fetches=fetches)
+    return _report_exit(args.model_dir, report, args, plan=plan,
+                        donation=dplan)
 
 
 def lint_golden(args):
@@ -167,7 +215,7 @@ def lint_golden(args):
     clean against that mesh description."""
     from paddle_tpu import analysis
 
-    results = []  # (fixture name, report, sharding plan or None)
+    results = []  # (name, report, sharding plan, donation plan)
     for name, desc in _golden_descs(args.golden):
         if args.passes:
             # lint the POST-PASS program: the optimized IR is what
@@ -183,7 +231,8 @@ def lint_golden(args):
             desc, level=args.level, suppress=_split(args.suppress),
             origin="lint_golden")
         plan = _shard_analyze(desc, args, report)
-        results.append((name, report, plan))
+        dplan = _donation_analyze(desc, args, report)
+        results.append((name, report, plan, dplan))
     if not results:
         print("[lint] no golden ProgramDesc fixtures found")
         return 1
@@ -192,19 +241,22 @@ def lint_golden(args):
         # json.dumps per fixture
         docs = []
         rc = 0
-        for name, report, plan in results:
+        for name, report, plan, dplan in results:
             d = report.to_dict()
             d["target"] = name
             if plan is not None:
                 d["sharding"] = plan.to_dict()
+            if dplan is not None:
+                d["donation"] = dplan.to_dict()
             docs.append(d)
             if report.errors or (args.strict and report.warnings):
                 rc = 1
         print(json.dumps(docs, indent=1, sort_keys=True))
         return rc
     rc = 0
-    for name, report, plan in results:
-        rc |= _report_exit(name, report, args, plan=plan)
+    for name, report, plan, dplan in results:
+        rc |= _report_exit(name, report, args, plan=plan,
+                           donation=dplan)
     return rc
 
 
@@ -416,6 +468,134 @@ def _shard_corruptions():
     ]
 
 
+def _build_two_segment():
+    """fc -> print -> mean: the host print op splits block 0 into two
+    jit segments, so the fc output crosses a segment boundary and the
+    tail segment can (provably) donate it.  Returns (main, startup,
+    intermediate name, loss name)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.desc import OpDesc
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8)
+        loss = fluid.layers.mean(x=h)
+    bd = main.desc.block(0)
+    i = next(i for i, od in enumerate(bd.ops) if od.type == "mean")
+    bd.ops.insert(i, OpDesc("print", {"X": [h.name]},
+                            {"Out": [h.name]},
+                            {"message": "seg-split", "summarize": 1}))
+    return main, startup, h.name, loss.name
+
+
+def _donation_corruptions():
+    """[(label, expected A-code, run(analysis) -> Report)] — one
+    seeded donation-safety corruption per stable A0xx code."""
+    from paddle_tpu.core.desc import OpDesc
+    from paddle_tpu.tools.mem_cli import (_build_adam_toy,
+                                          _fork_adam_slot)
+
+    def a001_forked_slot(analysis):
+        main, _startup, cost = _build_adam_toy()
+        _fork_adam_slot(main)
+        return analysis.analyze_donation(
+            main, fetches=[cost.name], publish=False).report
+
+    def a002_late_reader(analysis):
+        # plan first, then the program grows a reader of the donated
+        # intermediate: replaying the stale plan must be an ERROR
+        main, _startup, hname, lname = _build_two_segment()
+        plan = analysis.analyze_donation(main, fetches=[lname],
+                                         feeds=["x"], publish=False)
+        assert any(hname in s["widened"] for s in plan.segments), \
+            "two-segment seed did not widen %r: %r" \
+            % (hname, [s["widened"] for s in plan.segments])
+        main.desc.block(0).ops.append(
+            OpDesc("scale", {"X": [hname]}, {"Out": ["__late__"]},
+                   {"scale": 2.0}))
+        return plan.verify(main, fetches=[lname, "__late__"])
+
+    def a003_fetched_candidate(analysis):
+        main, _startup, hname, lname = _build_two_segment()
+        return analysis.analyze_donation(
+            main, fetches=[hname, lname], feeds=["x"],
+            publish=False).report
+
+    def a004_non_jit_update(analysis):
+        # dist_send declares ParamOut in-place but is not jittable:
+        # the declared reuse strands in the eager segment
+        main, _startup, cost = _build_adam_toy()
+        bd = main.desc.block(0)
+        pname = next(n for n, vd in bd.vars.items()
+                     if vd.is_parameter)
+        bd.ops.append(OpDesc("dist_send",
+                             {"Param": [pname], "Grad": [pname]},
+                             {"ParamOut": [pname]},
+                             {"param_name": pname, "blocks": []}))
+        return analysis.analyze_donation(
+            main, fetches=[cost.name], publish=False).report
+
+    def a005_unsafe_backend(analysis):
+        main, _startup, cost = _build_adam_toy()
+        return analysis.analyze_donation(
+            main, fetches=[cost.name], mode="auto",
+            backend_safe=False, publish=False).report
+
+    return [
+        ("forked in-place slot", "A001", a001_forked_slot),
+        ("read-after-donation hazard", "A002", a002_late_reader),
+        ("fetch aliases donatable buffer", "A003",
+         a003_fetched_candidate),
+        ("in-place update stranded non-jit", "A004",
+         a004_non_jit_update),
+        ("donation-unsafe backend", "A005", a005_unsafe_backend),
+    ]
+
+
+def _selftest_donation(args):
+    """The donation-safety analyzer leg of --selftest."""
+    from paddle_tpu import analysis
+    from paddle_tpu.tools.mem_cli import _build_adam_toy
+
+    # 1. clean targets plan with zero A-code findings: the adam toy
+    #    (donates its conservative set), lenet5, every golden fixture
+    main, _startup, cost = _build_adam_toy()
+    plan = analysis.analyze_donation(main, fetches=[cost.name],
+                                     publish=False)
+    assert plan.report.ok() and not plan.report.codes(), \
+        "clean adam toy reported:\n%s" % plan.report.format()
+    assert any(plan.donate(i) for i in range(len(plan.segments))), \
+        "clean adam toy donates nothing"
+    lenet_main, lenet_loss = _build_lenet5_train()
+    targets = [("lenet5", lenet_main, [lenet_loss])]
+    targets += [(name, desc, None) for name, desc in _golden_descs()]
+    for name, prog, fetches in targets:
+        p = analysis.analyze_donation(prog, fetches=fetches,
+                                      publish=False)
+        assert p.report.ok(), "%s donation plan has errors:\n%s" \
+            % (name, p.report.format())
+
+    # 2. every seeded corruption reports its exact A-code
+    for label, code, run in _donation_corruptions():
+        report = run(analysis)
+        assert report.has(code), \
+            "%s: expected %s, got codes %s\n%s" \
+            % (label, code, report.codes(), report.format())
+
+    # 3. the mode ladder is ordered: off donates nothing,
+    #    conservative a subset of auto, and the fingerprints differ
+    plans = {m: analysis.analyze_donation(main, fetches=[cost.name],
+                                          mode=m, publish=False)
+             for m in ("off", "conservative", "auto")}
+    for i in range(len(plans["auto"].segments)):
+        assert plans["off"].donate(i) == ()
+        assert set(plans["conservative"].donate(i)) <= \
+            set(plans["auto"].donate(i))
+    assert plans["off"].fingerprint() != plans["auto"].fingerprint()
+    return len(_donation_corruptions())
+
+
 def _selftest_sharding(args):
     """The sharding analyzer leg of --selftest."""
     import paddle_tpu.fluid as fluid  # noqa: F401  (program builders)
@@ -546,15 +726,21 @@ def selftest(args):
     #    comm cost model in the registry
     n_shard = _selftest_sharding(args)
 
+    # 7. the donation-safety analyzer: clean programs plan green,
+    #    seeded A0xx corruptions each caught, mode ladder ordered
+    n_donation = _selftest_donation(args)
+
     print("[lint] selftest green: clean program verified (0 errors), "
           "%d seeded corruptions each reported their code, "
           "suppression filters, executor FLAGS_verify_program gate "
           "rejects pre-compile with op identity, finding counters in "
           "the registry; sharding: lenet5 + golden fixtures clean on "
           "%d dryrun mesh shapes, %d seeded S-code corruptions each "
-          "caught, comm bytes published"
+          "caught, comm bytes published; donation: clean targets "
+          "plan green, %d seeded A-code corruptions each caught, "
+          "off/conservative/auto ladder ordered"
           % (len(_corruptions(main, loss_name, param_name)),
-             len(DRYRUN_MESHES), n_shard), flush=True)
+             len(DRYRUN_MESHES), n_shard, n_donation), flush=True)
     return 0
 
 

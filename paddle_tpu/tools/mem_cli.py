@@ -329,6 +329,19 @@ def selftest(args):
     assert hits and hits[0]["bytes"] > 0 \
         and hits[0]["kind"] == "optimizer_state", \
         obs_mem.render_audit(broken)
+    # the refusal is explained, not just priced: the forked slot
+    # carries its A-code (analysis/alias.py) in entry and rendering
+    assert hits[0].get("code") == "A001", hits[0]
+    assert "A001" in obs_mem.render_audit(broken)
+    # the plan closes what the audit prices: flag off, every donated
+    # buffer moves to reclaimable — the off/auto delta IS the win
+    off = obs_mem.audit_donation(adam_main, fetches=[adam_cost.name],
+                                 mode="off")
+    assert not off["donated"], obs_mem.render_audit(off)
+    assert off["reclaimable_bytes"] == (broken["reclaimable_bytes"]
+                                        + broken["donated_bytes"]), \
+        (off["reclaimable_bytes"], broken["reclaimable_bytes"],
+         broken["donated_bytes"])
 
     # --- leg 4: forced-tiny-budget OOM -> flight bundle with blame -----
     recorder = obs_flight.install(out_dir=workdir, capacity=8)
@@ -367,8 +380,9 @@ def selftest(args):
           "op %s (%s), counter track %d event(s); drift joined %d "
           "segment(s) median ratio %.3f -> calibration %s; donation "
           "audit: clean program donates %d buffer(s), forked Adam "
-          "slot %r flagged with %.1f KiB reclaimable; OOM bundle %s "
-          "blames %r"
+          "slot %r flagged A001 with %.1f KiB reclaimable and "
+          "FLAGS_donation=off surrenders the full delta; OOM bundle "
+          "%s blames %r"
           % (tl["ops"], tl["peak_bytes"] / 2**20, tl["peak_op"],
              tl["peak_op_type"], len(events), rep["n"],
              rep["median_ratio"], cal_path, len(clean["donated"]),

@@ -160,6 +160,20 @@ DEFINE_flag("compile_passes", "",
             "feeds the executable-cache fingerprint so cached "
             "entries never alias across pass configs.  Empty (the "
             "default) compiles programs exactly as built")
+DEFINE_flag("donation", "auto",
+            "jit-segment buffer donation policy (analysis/alias.py). "
+            "'conservative' donates the executor's classic "
+            "outputs-intersect-reads set (in-place param/state "
+            "updates); 'auto' (default) additionally donates every "
+            "buffer the A0xx donation-safety analysis proves dead "
+            "after its segment — and degrades itself to "
+            "'conservative' when pcache.donation_aliasing_safe() says "
+            "reloaded executables drop the aliasing, or when the "
+            "analysis fails for any reason; 'off' disables donation "
+            "entirely (the numerics-baseline mode: donation is "
+            "value-preserving, so off/auto must match bit-for-bit). "
+            "The mode folds into the compile-cache key — a flag flip "
+            "can never serve a stale executable")
 DEFINE_flag("amp_bf16_act", True,
             "when amp_bf16 is on, keep activations bfloat16 between ops "
             "instead of casting every MXU output back to f32 — halves "

@@ -84,6 +84,23 @@ for mesh in dp=4,mp=2 dp=2,mp=2,sp=2 pp=4,dp=2 dp=2,ep=4; do
         --mesh "$mesh"
 done
 
+echo "[ci] proglint --donation over golden fixtures (alias analysis must plan every pinned program with 0 errors) ..."
+timeout 300 python -m paddle_tpu.tools.lint_cli --golden --quiet \
+    --donation
+
+echo "[ci] pmem audit under FLAGS_donation=auto (lenet5 must have 0 reclaimable bytes: everything provably donatable is donated or carries an A-code) ..."
+timeout 300 env FLAGS_donation=auto python -m paddle_tpu.tools.mem_cli \
+    audit --model lenet5 --json | python -c "
+import json, sys
+a = json.load(sys.stdin)
+assert a['effective_mode'] == 'auto', a.get('effective_mode')
+assert a['reclaimable_bytes'] == 0, \
+    'lenet5 under auto left %d reclaimable bytes: %r' \
+    % (a['reclaimable_bytes'], a['reclaimable'])
+print('[ci] lenet5 donation audit: %d bytes donated, 0 reclaimable'
+      % a['donated_bytes'])
+"
+
 echo "[ci] driver entry points ..."
 # two bench runs against one persistent compile cache: the cold run
 # populates it, the warm rerun's stamped compile_cache blob must show

@@ -17,9 +17,11 @@ export PYTHONPATH=
 python -m compileall -q paddle_tpu tests examples bench.py __graft_entry__.py
 make -C native -q || make -C native
 # the checked-in golden ProgramDescs must be well-formed IR, not just
-# byte-stable: proglint walks each fixture through the full verifier
-# AND the SPMD analyzer under the default dryrun mesh
-python -m paddle_tpu.tools.lint_cli --golden --quiet --mesh dp=4,mp=2
+# byte-stable: proglint walks each fixture through the full verifier,
+# the SPMD analyzer under the default dryrun mesh, AND the donation
+# alias analysis (a pinned program must always plan with 0 A errors)
+python -m paddle_tpu.tools.lint_cli --golden --quiet --mesh dp=4,mp=2 \
+    --donation
 python -m pytest tests/test_math_ops.py tests/test_fit_a_line.py -q
 EOF
 chmod +x "$hook"

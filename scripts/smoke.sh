@@ -40,7 +40,7 @@ timeout 300 python -m paddle_tpu.tools.perf_cli --selftest
 echo "[smoke] pload selftest (open vs closed loop omission gap, tail join, replay fidelity, latency gate) ..."
 timeout 300 python -m paddle_tpu.tools.load_cli --selftest
 
-echo "[smoke] pmem selftest (memory timeline, drift join + calibration, donation audit, OOM flight bundle) ..."
+echo "[smoke] pmem selftest (memory timeline, drift join + calibration, A-coded donation audit + off/auto delta, OOM flight bundle) ..."
 timeout 300 python -m paddle_tpu.tools.mem_cli --selftest
 
 echo "[smoke] pcomm selftest (comm spans, overlap split, cross-host merge, comm gate) ..."
@@ -49,7 +49,7 @@ timeout 300 python -m paddle_tpu.tools.comm_cli --selftest
 echo "[smoke] ptune selftest (deterministic plan, S002/S005 rejected pre-measurement, measured top-K + calibration) ..."
 timeout 600 python -m paddle_tpu.tools.tune_cli --selftest
 
-echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate + sharding analyzer over the 4 dryrun meshes) ..."
+echo "[smoke] proglint selftest (verifier + hazard detector + executor verify gate + sharding analyzer over the 4 dryrun meshes + donation A-code corruptions) ..."
 timeout 300 python -m paddle_tpu.tools.lint_cli --selftest --mesh dp=4,mp=2
 
 echo "[smoke] pshard selftest (rule precedence, plan round-trip, plan-driven SPMD step, sharded ckpt) ..."

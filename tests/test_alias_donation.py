@@ -1,0 +1,317 @@
+"""Alias & donation-safety analysis (analysis/alias.py, A0xx codes)
+and its executor/trainer/audit wiring behind FLAGS_donation.
+
+Donation is value-preserving: XLA reuses the donated input's buffer
+for an output, so numerics across off/conservative/auto must be
+BIT-identical on f32 — several tests below pin exactly that.  On the
+CPU backend donation is a silent no-op (and
+`pcache.donation_aliasing_safe()` is False), so tests that need the
+widened path monkeypatch the backend-safety probe rather than assert
+buffer deletion.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.compile import pcache
+from paddle_tpu.core.desc import OpDesc
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.obs import mem as obs_mem
+from paddle_tpu.tools.lint_cli import _build_two_segment
+from paddle_tpu.tools.mem_cli import _build_adam_toy, _fork_adam_slot
+from paddle_tpu.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _restore_donation_flags():
+    old = {k: flags.get_flag(k)
+           for k in ("donation", "compile_cache_dir")}
+    yield
+    for k, v in old.items():
+        flags.set_flag(k, v)
+    pcache.reset()
+
+
+def _feeds(rs=None, n=4, d=64):
+    rs = rs or np.random.RandomState(0)
+    return {"x": rs.randn(n, d).astype(np.float32)}
+
+
+def _train_losses(main, startup, cost, steps=4, d=64):
+    """Fresh Executor+Scope run; returns (per-step losses, final
+    param values) for exact cross-mode comparison."""
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        rs = np.random.RandomState(0)
+        losses = []
+        for _ in range(steps):
+            out, = exe.run(main, feed=_feeds(rs, d=d),
+                           fetch_list=[cost], scope=scope)
+            losses.append(np.asarray(out).copy())
+        params = {n: np.asarray(scope.get(n)).copy()
+                  for n in main.global_block().vars
+                  if scope.get(n) is not None}
+    return losses, params, exe
+
+
+# -- the plan ---------------------------------------------------------------
+
+def test_mode_ladder_and_fingerprints():
+    main, _startup, cost = _build_adam_toy()
+    plans = {m: analysis.analyze_donation(main, fetches=[cost.name],
+                                          mode=m)
+             for m in ("off", "conservative", "auto")}
+    auto = plans["auto"]
+    assert not auto.report.errors
+    nseg = len(auto.segments)
+    assert any(auto.donate(i) for i in range(nseg))
+    for i in range(nseg):
+        assert plans["off"].donate(i) == ()
+        assert set(plans["conservative"].donate(i)) \
+            <= set(auto.donate(i))
+    # the three modes can never share an executable
+    fps = {m: p.fingerprint() for m, p in plans.items()}
+    assert len(set(fps.values())) == 3, fps
+
+
+def test_feed_is_never_widened():
+    main, _startup, cost = _build_adam_toy()
+    plan = analysis.analyze_donation(main, fetches=[cost.name],
+                                     mode="auto")
+    for i in range(len(plan.segments)):
+        assert "x" not in plan.donate(i)
+    # same result whether or not the caller names its feeds: a name
+    # read before any def site is caller-owned regardless
+    plan2 = analysis.analyze_donation(main, fetches=[cost.name],
+                                      feeds=["x"], mode="auto")
+    assert plan2.fingerprint() == plan.fingerprint()
+
+
+def test_donation_mode_parsing():
+    assert analysis.donation_mode("off") == "off"
+    assert analysis.donation_mode("bogus") == "auto"
+    from paddle_tpu.analysis.alias import state_donation
+
+    flags.set_flag("donation", "off")
+    assert state_donation() is False
+    flags.set_flag("donation", "auto")
+    assert state_donation() is True
+
+
+# -- the A-codes ------------------------------------------------------------
+
+def test_a001_forked_slot_and_audit_delta():
+    main, _startup, cost = _build_adam_toy()
+    forked = _fork_adam_slot(main)
+    plan = analysis.analyze_donation(main, fetches=[cost.name],
+                                     mode="auto")
+    assert "A001" in plan.report.codes()
+    broken = obs_mem.audit_donation(main, fetches=[cost.name],
+                                    mode="auto")
+    hits = [r for r in broken["reclaimable"] if r["name"] == forked]
+    assert hits and hits[0].get("code") == "A001"
+    assert broken["reclaimable_bytes"] > 0
+    # FLAGS_donation=off surrenders exactly the donated bytes on top
+    off = obs_mem.audit_donation(main, fetches=[cost.name],
+                                 mode="off")
+    assert not off["donated"]
+    assert off["reclaimable_bytes"] == (broken["reclaimable_bytes"]
+                                        + broken["donated_bytes"])
+
+
+def test_a002_read_after_donation_via_stale_plan():
+    main, _startup, hname, lname = _build_two_segment()
+    plan = analysis.analyze_donation(main, fetches=[lname],
+                                     mode="auto")
+    assert any(hname in plan.widened(i)
+               for i in range(len(plan.segments)))
+    # mutate the program AFTER planning: a later op now reads the
+    # donated buffer — verify() must refuse the stale plan
+    main.desc.block(0).ops.append(
+        OpDesc("scale", {"X": [hname]}, {"Out": ["__late__"]},
+               {"scale": 2.0}))
+    rep = plan.verify(main, fetches=[lname, "__late__"])
+    assert "A002" in rep.codes()
+    assert rep.errors
+
+
+def test_a003_fetch_declines_widening():
+    main, _startup, hname, lname = _build_two_segment()
+    plan = analysis.analyze_donation(main, fetches=[lname, hname],
+                                     mode="auto")
+    assert "A003" in plan.report.codes()
+    assert not any(hname in plan.widened(i)
+                   for i in range(len(plan.segments)))
+
+
+def test_a005_unsafe_backend_degrades():
+    main, _startup, cost = _build_adam_toy()
+    plan = analysis.analyze_donation(main, fetches=[cost.name],
+                                     mode="auto", backend_safe=False)
+    assert plan.effective_mode == "conservative"
+    assert "A005" in plan.report.codes()
+    assert not plan.report.errors
+
+
+# -- executor wiring --------------------------------------------------------
+
+def test_executor_applies_widened_plan(monkeypatch):
+    monkeypatch.setattr(pcache, "donation_aliasing_safe",
+                        lambda backend=None: True)
+    flags.set_flag("donation", "auto")
+    main, startup, hname, lname = _build_two_segment()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, feed={"x": np.zeros((4, 16), np.float32)},
+                fetch_list=[lname], scope=scope)
+    cp = list(exe._cache.values())[-1]
+    assert cp._donation["mode"] == "auto"
+    muts = [j["mutated"] for j in cp._jit_cache.values()]
+    assert any(hname in m for m in muts), muts
+
+
+def test_auto_degrades_on_unsafe_backend_bit_identical(monkeypatch):
+    """Satellite: on a backend where executable reload drops donation
+    aliasing, auto quietly becomes conservative and numerics match
+    off exactly."""
+    monkeypatch.setattr(pcache, "donation_aliasing_safe",
+                        lambda backend=None: False)
+    runs = {}
+    for mode in ("off", "auto"):
+        flags.set_flag("donation", mode)
+        main, startup, cost = _build_adam_toy()
+        runs[mode] = _train_losses(main, startup, cost)
+    _losses, _params, exe = runs["auto"]
+    cp = list(exe._cache.values())[-1]
+    assert cp._donation["mode"] == "conservative"
+    for a, b in zip(runs["off"][0], runs["auto"][0]):
+        np.testing.assert_array_equal(a, b)
+    for n, v in runs["off"][1].items():
+        np.testing.assert_array_equal(v, runs["auto"][1][n])
+
+
+def test_modes_bit_identical_f32(monkeypatch):
+    """The core safety property: donation never changes a value.
+    Backend forced 'safe' so auto actually widens."""
+    monkeypatch.setattr(pcache, "donation_aliasing_safe",
+                        lambda backend=None: True)
+    runs = {}
+    for mode in ("off", "conservative", "auto"):
+        flags.set_flag("donation", mode)
+        main, startup, cost = _build_adam_toy()
+        runs[mode] = _train_losses(main, startup, cost)
+    ref_losses, ref_params, _ = runs["off"]
+    for mode in ("conservative", "auto"):
+        losses, params, _ = runs[mode]
+        for a, b in zip(ref_losses, losses):
+            np.testing.assert_array_equal(a, b)
+        for n, v in ref_params.items():
+            np.testing.assert_array_equal(v, params[n])
+
+
+def test_donation_under_amp_bf16(monkeypatch):
+    """Satellite: under amp_bf16 the state dtypes take two steps to
+    reach their fixed point (f32 -> bf16 -> f32 masters).  The
+    donation plan must ride the re-traces: after the fixed point no
+    segment traces again, and auto matches off bit-for-bit (same
+    casts, donation is aliasing only)."""
+    monkeypatch.setattr(pcache, "donation_aliasing_safe",
+                        lambda backend=None: True)
+    runs = {}
+    for mode in ("off", "auto"):
+        flags.set_flag("donation", mode)
+        with fluid.amp.bf16_guard():
+            main, startup, cost = _build_adam_toy()
+            runs[mode] = _train_losses(main, startup, cost, steps=5)
+    for a, b in zip(runs["off"][0], runs["auto"][0]):
+        np.testing.assert_array_equal(a, b)
+    # signature fixed point: at most 3 traces over 5 steps (f32 ->
+    # bf16 transient -> steady); a donated-dtype mismatch against the
+    # runtime signature would retrace on EVERY step (>= 5)
+    _losses, _params, exe = runs["auto"]
+    cp = list(exe._cache.values())[-1]
+    assert cp._donation["mode"] == "auto"
+    sizes = {i: j["fn"]._cache_size()
+             for i, j in cp._jit_cache.items()}
+    assert sizes and all(s <= 3 for s in sizes.values()), sizes
+
+
+# -- compile-cache key separation -------------------------------------------
+
+def _build_two_segment_infer():
+    """fc -> print -> mean with NO optimizer: zero in-place ops, so
+    the program is donation-free on every backend and all three
+    modes' pcache entries are non-donated (reloadable even where
+    donation_aliasing_safe is False)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8)
+        loss = fluid.layers.mean(x=h)
+    bd = main.desc.block(0)
+    i = next(i for i, od in enumerate(bd.ops)
+             if od.type == "mean")
+    bd.ops.insert(i, OpDesc("print", {"X": [h.name]},
+                            {"Out": [h.name]},
+                            {"message": "seg", "summarize": 1}))
+    return main, startup, loss.name
+
+
+def test_pcache_keys_separate_modes(tmp_path):
+    """FLAGS_donation folds into the persistent-cache keys: each mode
+    populates its own entries cold and reloads its own warm (0 new
+    entries), never another mode's."""
+    from paddle_tpu.obs import telemetry as obs_tele
+
+    flags.set_flag("compile_cache_dir", str(tmp_path))
+    x = np.zeros((4, 16), np.float32)
+
+    def run_once(mode):
+        flags.set_flag("donation", mode)
+        main, startup, lname = _build_two_segment_infer()
+        scope = Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            exe.run(main, feed={"x": x}, fetch_list=[lname],
+                    scope=scope)
+
+    entries = {}
+    for mode in ("off", "conservative", "auto"):
+        before = pcache.get_cache().stats()["entries"]
+        run_once(mode)
+        entries[mode] = pcache.get_cache().stats()["entries"]
+        assert entries[mode] > before, \
+            "mode %r reused another mode's entries" % mode
+    # warm rerun per mode: 0 fresh entries, served from disk
+    for mode in ("off", "conservative", "auto"):
+        pcache.reset()
+        before = pcache.get_cache().stats()["entries"]
+        hits0 = obs_tele.snapshot().get("compile_cache_hits_total", 0)
+        run_once(mode)
+        assert pcache.get_cache().stats()["entries"] == before
+        assert obs_tele.snapshot().get("compile_cache_hits_total",
+                                       0) > hits0
+
+
+# -- audit ------------------------------------------------------------------
+
+def test_audit_clean_toy_zero_reclaimable_under_auto():
+    main, _startup, cost = _build_adam_toy()
+    audit = obs_mem.audit_donation(main, fetches=[cost.name],
+                                   mode="auto")
+    assert audit["effective_mode"] == "auto"
+    assert audit["reclaimable_bytes"] == 0, audit["reclaimable"]
+    assert audit["donated_bytes"] > 0
+    # every reclaimable entry in ANY mode carries its explanation
+    off = obs_mem.audit_donation(main, fetches=[cost.name],
+                                 mode="off")
+    assert off["reclaimable_bytes"] > 0
+    for r in off["reclaimable"]:
+        assert r["reason"]
